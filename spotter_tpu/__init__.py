@@ -1,0 +1,15 @@
+"""spotter-tpu: a TPU-native object-detection serving framework.
+
+Capability contract mirrors chilir/spotter (reference at /root/reference):
+a control plane that deploys/deletes the serving app as a KubeRay RayService and
+proxies `/detect` (apps/spotter-manager), plus a Python serving layer that detects
+"amenities" in images fetched from URLs (apps/spotter/src/spotter/serve.py).
+
+The compute path is rebuilt TPU-first: Flax model implementations compiled with
+jax.jit/pjit, static-shape input bucketing, fixed-k postprocess, device-mesh
+data/model parallelism via jax.sharding, and XLA collectives over ICI/DCN.
+"""
+
+__version__ = "0.1.0"
+
+from spotter_tpu.taxonomy import AMENITIES_MAPPING  # noqa: F401

@@ -1,0 +1,1 @@
+from spotter_tpu.ops import boxes, postprocess, preprocess  # noqa: F401

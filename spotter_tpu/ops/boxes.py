@@ -1,0 +1,63 @@
+"""Box geometry ops in jnp — jit-friendly, static shapes throughout.
+
+Replaces the torch box handling inside the reference's HF postprocess call
+(apps/spotter/src/spotter/serve.py:102-109) with pure-jnp equivalents usable both
+in inference postprocess and in training losses (GIoU).
+"""
+
+import jax.numpy as jnp
+
+
+def center_to_corners(boxes: jnp.ndarray) -> jnp.ndarray:
+    """(..., 4) [cx, cy, w, h] -> [xmin, ymin, xmax, ymax]."""
+    cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate(
+        [cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h], axis=-1
+    )
+
+
+def corners_to_center(boxes: jnp.ndarray) -> jnp.ndarray:
+    """(..., 4) [xmin, ymin, xmax, ymax] -> [cx, cy, w, h]."""
+    x0, y0, x1, y1 = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate(
+        [(x0 + x1) / 2, (y0 + y1) / 2, x1 - x0, y1 - y0], axis=-1
+    )
+
+
+def scale_boxes(boxes: jnp.ndarray, target_sizes: jnp.ndarray) -> jnp.ndarray:
+    """Scale normalized corner boxes (B, Q, 4) to pixel coords.
+
+    target_sizes: (B, 2) as [height, width] — same convention as the reference's
+    `target_sizes = [[image.size[1], image.size[0]]]` (serve.py:102).
+    """
+    h = target_sizes[..., 0:1]
+    w = target_sizes[..., 1:2]
+    scale = jnp.stack([w, h, w, h], axis=-1).reshape(*target_sizes.shape[:-1], 1, 4)
+    return boxes * scale
+
+
+def box_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    """Area of corner-format boxes (..., 4) -> (...)."""
+    return jnp.clip(boxes[..., 2] - boxes[..., 0], 0) * jnp.clip(
+        boxes[..., 3] - boxes[..., 1], 0
+    )
+
+
+def box_iou(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Pairwise IoU between corner boxes a (N, 4) and b (M, 4) -> (N, M), union (N, M)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(a)[:, None] + box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9), union
+
+
+def generalized_box_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise GIoU between corner boxes a (N, 4) and b (M, 4) -> (N, M)."""
+    iou, union = box_iou(a, b)
+    lt = jnp.minimum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.maximum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    hull = wh[..., 0] * wh[..., 1]
+    return iou - (hull - union) / jnp.maximum(hull, 1e-9)

@@ -1,0 +1,107 @@
+"""Detection postprocess in jnp — fixed output shapes, jit/TPU friendly.
+
+Replaces the reference's torch `post_process_object_detection(threshold=0.5, ...)`
+call (apps/spotter/src/spotter/serve.py:102-109). On TPU, thresholding would make
+output shapes data-dependent, so the device side always returns fixed-k
+(scores, labels, boxes) tensors; the host converts to thresholded Python lists
+(`to_detections`), preserving the reference's observable behavior.
+
+Three device-side variants cover the model families in scope:
+- sigmoid top-k over (query, class)   — RT-DETR / RT-DETRv2 (focal-loss heads)
+- softmax per query, no-object drop   — DETR, YOLOS
+- sigmoid max over text queries       — OWL-ViT (open-vocabulary)
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spotter_tpu.ops.boxes import center_to_corners, scale_boxes
+
+
+@partial(jax.jit, static_argnames=("k",))
+def sigmoid_topk_postprocess(
+    logits: jnp.ndarray,
+    pred_boxes: jnp.ndarray,
+    target_sizes: jnp.ndarray,
+    k: int = 300,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """RT-DETR-style postprocess.
+
+    logits: (B, Q, C) raw class logits; pred_boxes: (B, Q, 4) normalized cxcywh;
+    target_sizes: (B, 2) [h, w]. Returns scores (B, k), labels (B, k), boxes
+    (B, k, 4) xyxy pixels — top-k over the flattened (query, class) axis, the
+    NMS-free selection RT-DETR uses.
+    """
+    b, q, c = logits.shape
+    scores = jax.nn.sigmoid(logits).reshape(b, q * c)
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    labels = top_idx % c
+    query_idx = top_idx // c
+    boxes = jnp.take_along_axis(pred_boxes, query_idx[..., None], axis=1)
+    boxes = center_to_corners(boxes)
+    boxes = scale_boxes(boxes, target_sizes.astype(boxes.dtype))
+    return top_scores, labels, boxes
+
+
+@jax.jit
+def softmax_postprocess(
+    logits: jnp.ndarray,
+    pred_boxes: jnp.ndarray,
+    target_sizes: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """DETR/YOLOS-style postprocess.
+
+    The final class is "no object" and is dropped before the per-query argmax.
+    Returns scores (B, Q), labels (B, Q), boxes (B, Q, 4) xyxy pixels.
+    """
+    probs = jax.nn.softmax(logits, axis=-1)[..., :-1]
+    scores = probs.max(axis=-1)
+    labels = probs.argmax(axis=-1)
+    boxes = center_to_corners(pred_boxes)
+    boxes = scale_boxes(boxes, target_sizes.astype(boxes.dtype))
+    return scores, labels, boxes
+
+
+@jax.jit
+def sigmoid_max_postprocess(
+    logits: jnp.ndarray,
+    pred_boxes: jnp.ndarray,
+    target_sizes: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """OWL-ViT-style postprocess: per-query sigmoid max over text-query classes."""
+    probs = jax.nn.sigmoid(logits)
+    scores = probs.max(axis=-1)
+    labels = probs.argmax(axis=-1)
+    boxes = center_to_corners(pred_boxes)
+    boxes = scale_boxes(boxes, target_sizes.astype(boxes.dtype))
+    return scores, labels, boxes
+
+
+def to_detections(
+    scores: np.ndarray | jnp.ndarray,
+    labels: np.ndarray | jnp.ndarray,
+    boxes: np.ndarray | jnp.ndarray,
+    id2label: dict[int, str],
+    threshold: float = 0.5,
+) -> list[dict]:
+    """Host-side: one image's fixed-k device output -> thresholded detections.
+
+    Matches the observable result of the reference's threshold=0.5 filter + id2label
+    lookup (serve.py:102-114): a list of {"label": str, "score": float,
+    "box": [xmin, ymin, xmax, ymax]} dicts.
+    """
+    scores = np.asarray(scores)
+    labels = np.asarray(labels)
+    boxes = np.asarray(boxes)
+    keep = scores > threshold
+    return [
+        {
+            "label": id2label[int(lbl)],
+            "score": float(s),
+            "box": [float(v) for v in box],
+        }
+        for s, lbl, box in zip(scores[keep], labels[keep], boxes[keep])
+    ]

@@ -1,0 +1,116 @@
+"""Image preprocess: host-side decode/resize, device-friendly static shapes.
+
+Replaces the reference's `processor(images=image, return_tensors="pt")` call
+(apps/spotter/src/spotter/serve.py:98). TPU discipline (SURVEY.md §5.7): every
+tensor that reaches jit has a shape from a small fixed set, so XLA never
+recompiles per request. Aspect-changing models (RT-DETR, OWL-ViT) already have a
+single static size; shortest-edge models (DETR, YOLOS) resize
+aspect-preserving and pad into a fixed bucket with a pixel mask.
+
+Arrays are NHWC — the natural TPU/XLA convolution layout (torch parity tests
+transpose to NCHW at the boundary).
+"""
+
+from dataclasses import dataclass, field
+
+import numpy as np
+from PIL import Image
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+@dataclass(frozen=True)
+class PreprocessSpec:
+    """How to turn a PIL image into a model input array.
+
+    mode "fixed": warp-resize to `size` (h, w) — RT-DETR (640, 640), OWL-ViT
+    (768, 768). mode "shortest_edge": aspect-preserving resize so the short side
+    is size[0] without the long side exceeding size[1], then zero-pad to the
+    (size[1], size[1])-bounded bucket — DETR/YOLOS (800, 1333).
+    """
+
+    mode: str = "fixed"
+    size: tuple[int, int] = (640, 640)
+    rescale_factor: float = 1.0 / 255.0
+    mean: tuple[float, float, float] | None = None
+    std: tuple[float, float, float] | None = None
+    pad_to: tuple[int, int] | None = None  # static bucket for shortest_edge mode
+
+    @property
+    def input_hw(self) -> tuple[int, int]:
+        """The static (h, w) every preprocessed array has."""
+        if self.mode == "fixed":
+            return self.size
+        assert self.pad_to is not None
+        return self.pad_to
+
+
+RTDETR_SPEC = PreprocessSpec(mode="fixed", size=(640, 640))
+DETR_SPEC = PreprocessSpec(
+    mode="shortest_edge", size=(800, 1333), mean=IMAGENET_MEAN, std=IMAGENET_STD,
+    pad_to=(800, 1333),
+)
+OWLVIT_SPEC = PreprocessSpec(mode="fixed", size=(768, 768), mean=CLIP_MEAN, std=CLIP_STD)
+
+
+def shortest_edge_size(hw: tuple[int, int], short: int, longest: int) -> tuple[int, int]:
+    """Output (h, w) for aspect-preserving shortest-edge resize with a long-side cap."""
+    h, w = hw
+    lo, hi = (h, w) if h <= w else (w, h)
+    scale = short / lo
+    if hi * scale > longest:
+        scale = longest / hi
+    return max(1, round(h * scale)), max(1, round(w * scale))
+
+
+def preprocess_image(
+    image: Image.Image, spec: PreprocessSpec
+) -> tuple[np.ndarray, np.ndarray, tuple[int, int]]:
+    """PIL image -> (pixels NHWC-sans-N float32, pixel_mask (H, W) float32, orig (h, w)).
+
+    pixel_mask is all-ones for fixed mode; for shortest_edge mode it marks valid
+    (non-pad) pixels, the analog of HF DETR's pixel_mask.
+    """
+    orig_hw = (image.height, image.width)
+    if spec.mode == "fixed":
+        th, tw = spec.size
+        resized = image.resize((tw, th), resample=Image.BILINEAR)
+        arr = np.asarray(resized, dtype=np.float32)
+        mask = np.ones((th, tw), dtype=np.float32)
+    elif spec.mode == "shortest_edge":
+        rh, rw = shortest_edge_size(orig_hw, spec.size[0], spec.size[1])
+        resized = image.resize((rw, rh), resample=Image.BILINEAR)
+        ph, pw = spec.input_hw
+        arr = np.zeros((ph, pw, 3), dtype=np.float32)
+        arr[:rh, :rw] = np.asarray(resized, dtype=np.float32)
+        mask = np.zeros((ph, pw), dtype=np.float32)
+        mask[:rh, :rw] = 1.0
+    else:
+        raise ValueError(f"Unknown preprocess mode: {spec.mode}")
+
+    arr = arr * spec.rescale_factor
+    if spec.mean is not None:
+        arr = (arr - np.asarray(spec.mean, dtype=np.float32)) / np.asarray(
+            spec.std, dtype=np.float32
+        )
+    return arr, mask, orig_hw
+
+
+def batch_images(
+    images: list[Image.Image], spec: PreprocessSpec
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stack preprocessed images -> (pixels (B,H,W,3), masks (B,H,W), sizes (B,2) [h,w])."""
+    pixels, masks, sizes = [], [], []
+    for img in images:
+        p, m, hw = preprocess_image(img, spec)
+        pixels.append(p)
+        masks.append(m)
+        sizes.append(hw)
+    return (
+        np.stack(pixels),
+        np.stack(masks),
+        np.asarray(sizes, dtype=np.float32),
+    )

@@ -1,0 +1,37 @@
+"""Request/response schemas for the /detect API.
+
+Field names, nesting, and union shape are a wire contract with the reference
+(apps/spotter/src/spotter/schemas.py:6-32); clients of chilir/spotter must be able
+to talk to this service unchanged.
+"""
+
+from pydantic import BaseModel, HttpUrl
+
+
+class DetectionRequest(BaseModel):
+    image_urls: list[HttpUrl]
+
+
+class DetectionResult(BaseModel):
+    label: str
+    # [xmin, ymin, xmax, ymax] in original-image pixel coordinates
+    box: list[float]
+
+
+class DetectionSuccessResult(BaseModel):
+    url: str
+    detections: list[DetectionResult]
+    labeled_image_base64: str
+
+
+class DetectionErrorResult(BaseModel):
+    url: str
+    error: str
+
+
+ImageResult = DetectionSuccessResult | DetectionErrorResult
+
+
+class DetectionResponse(BaseModel):
+    amenities_description: str
+    images: list[ImageResult]

@@ -1,0 +1,41 @@
+"""Amenity taxonomy: COCO detection labels -> amenity names.
+
+Behavior contract with the reference (apps/spotter/src/spotter/serve.py:31-59):
+the same 22 COCO labels map to the same amenity strings; labels outside the
+mapping are dropped from results (serve.py:123-126).
+"""
+
+AMENITIES_MAPPING: dict[str, str] = {
+    # Kitchen
+    "refrigerator": "refrigerator",
+    "oven": "oven",
+    "microwave": "microwave",
+    "sink": "sink",  # Could be kitchen or bathroom
+    "dining table": "dining area",
+    "toaster": "toaster",
+    "wine glass": "kitchen",
+    "cup": "kitchen",
+    "fork": "kitchen",
+    "knife": "kitchen",
+    "spoon": "kitchen",
+    "bowl": "kitchen",
+    # Living Area
+    "tv": "TV",
+    "couch": "sofa",
+    "chair": "chair",
+    # Bedroom
+    "bed": "bed",
+    # Bathroom
+    "toilet": "bathroom",
+    "hair drier": "hair dryer",
+    # Workspace indicator
+    "laptop": "workspace",
+    "mouse": "workspace",
+    "keyboard": "workspace",
+    "car": "parking",
+}
+
+
+def amenity_for_label(label: str) -> str | None:
+    """Return the amenity name for a detector class label, or None if irrelevant."""
+    return AMENITIES_MAPPING.get(label)

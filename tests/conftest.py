@@ -1,0 +1,23 @@
+"""Test harness: CPU backend with an 8-device virtual mesh.
+
+SURVEY.md §4.4: multi-chip behavior is tested without hardware via
+`--xla_force_host_platform_device_count` — the moral equivalent of the
+reference's fake k8s dynamic client (handlers_test.go:19-20). These env vars
+must be set before jax is first imported, hence module scope here.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Surface NaNs produced inside jit in tests (SURVEY.md §5.2).
+os.environ.setdefault("JAX_DEBUG_NANS", "False")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)

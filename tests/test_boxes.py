@@ -1,0 +1,48 @@
+import jax.numpy as jnp
+import numpy as np
+
+from spotter_tpu.ops.boxes import (
+    box_iou,
+    center_to_corners,
+    corners_to_center,
+    generalized_box_iou,
+    scale_boxes,
+)
+
+
+def test_center_corner_round_trip():
+    boxes = jnp.array([[0.5, 0.5, 0.2, 0.4], [0.1, 0.9, 0.05, 0.1]])
+    np.testing.assert_allclose(
+        corners_to_center(center_to_corners(boxes)), boxes, atol=1e-6
+    )
+
+
+def test_center_to_corners_values():
+    out = center_to_corners(jnp.array([[0.5, 0.5, 1.0, 0.5]]))
+    np.testing.assert_allclose(out, [[0.0, 0.25, 1.0, 0.75]], atol=1e-6)
+
+
+def test_scale_boxes_hw_convention():
+    # target_sizes is [height, width] (serve.py:102)
+    boxes = jnp.array([[[0.0, 0.0, 1.0, 1.0]]])
+    out = scale_boxes(boxes, jnp.array([[480.0, 640.0]]))
+    np.testing.assert_allclose(out, [[[0.0, 0.0, 640.0, 480.0]]], atol=1e-5)
+
+
+def test_iou_identity_and_disjoint():
+    a = jnp.array([[0.0, 0.0, 2.0, 2.0]])
+    b = jnp.array([[0.0, 0.0, 2.0, 2.0], [3.0, 3.0, 4.0, 4.0], [1.0, 1.0, 3.0, 3.0]])
+    iou, _ = box_iou(a, b)
+    np.testing.assert_allclose(iou[0, 0], 1.0, atol=1e-6)
+    np.testing.assert_allclose(iou[0, 1], 0.0, atol=1e-6)
+    np.testing.assert_allclose(iou[0, 2], 1.0 / 7.0, atol=1e-6)
+
+
+def test_giou_bounds_and_disjoint_penalty():
+    a = jnp.array([[0.0, 0.0, 1.0, 1.0]])
+    b = jnp.array([[2.0, 2.0, 3.0, 3.0]])
+    giou = generalized_box_iou(a, b)
+    assert giou[0, 0] < 0  # disjoint boxes are penalized below zero
+    assert giou[0, 0] >= -1.0
+    same = generalized_box_iou(a, a)
+    np.testing.assert_allclose(same[0, 0], 1.0, atol=1e-6)

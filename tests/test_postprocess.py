@@ -1,0 +1,80 @@
+"""Postprocess parity vs the torch/HF semantics the reference relies on
+(serve.py:102-109): same top-k selection, label decoding, box scaling."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from spotter_tpu.ops.postprocess import (
+    sigmoid_max_postprocess,
+    sigmoid_topk_postprocess,
+    softmax_postprocess,
+    to_detections,
+)
+
+
+def _fake_outputs(b=2, q=10, c=5, seed=0):
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(size=(b, q, c)).astype(np.float32)
+    # valid normalized cxcywh boxes
+    cxcy = rng.uniform(0.3, 0.7, size=(b, q, 2))
+    wh = rng.uniform(0.05, 0.2, size=(b, q, 2))
+    boxes = np.concatenate([cxcy, wh], axis=-1).astype(np.float32)
+    sizes = np.array([[480.0, 640.0]] * b, dtype=np.float32)
+    return logits, boxes, sizes
+
+
+def test_sigmoid_topk_matches_numpy_reference():
+    logits, boxes, sizes = _fake_outputs()
+    k = 7
+    scores, labels, out_boxes = sigmoid_topk_postprocess(
+        jnp.asarray(logits), jnp.asarray(boxes), jnp.asarray(sizes), k=k
+    )
+    assert scores.shape == (2, k) and labels.shape == (2, k) and out_boxes.shape == (2, k, 4)
+
+    # independent numpy reference implementing the HF RT-DETR selection
+    for i in range(2):
+        flat = 1.0 / (1.0 + np.exp(-logits[i].reshape(-1)))
+        order = np.argsort(-flat)[:k]
+        np.testing.assert_allclose(np.asarray(scores[i]), flat[order], rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(labels[i]), order % logits.shape[-1])
+        qidx = order // logits.shape[-1]
+        cx, cy, w, h = boxes[i, qidx].T
+        expect = np.stack(
+            [
+                (cx - w / 2) * 640,
+                (cy - h / 2) * 480,
+                (cx + w / 2) * 640,
+                (cy + h / 2) * 480,
+            ],
+            axis=-1,
+        )
+        np.testing.assert_allclose(np.asarray(out_boxes[i]), expect, rtol=1e-4)
+
+
+def test_softmax_drops_no_object_class():
+    logits, boxes, sizes = _fake_outputs(c=4)
+    # make the "no object" (last) class dominant everywhere; it must be ignored
+    logits[..., -1] = 100.0
+    scores, labels, _ = softmax_postprocess(
+        jnp.asarray(logits), jnp.asarray(boxes), jnp.asarray(sizes)
+    )
+    assert int(np.asarray(labels).max()) <= 2
+    assert float(np.asarray(scores).max()) < 0.5
+
+
+def test_sigmoid_max_labels_are_argmax():
+    logits, boxes, sizes = _fake_outputs(c=3)
+    scores, labels, _ = sigmoid_max_postprocess(
+        jnp.asarray(logits), jnp.asarray(boxes), jnp.asarray(sizes)
+    )
+    np.testing.assert_array_equal(np.asarray(labels), logits.argmax(-1))
+
+
+def test_to_detections_threshold_and_labels():
+    scores = np.array([0.9, 0.4, 0.6])
+    labels = np.array([0, 1, 2])
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 2, 2], [5, 5, 6, 6]], dtype=np.float32)
+    id2label = {0: "tv", 1: "couch", 2: "chair"}
+    dets = to_detections(scores, labels, boxes, id2label, threshold=0.5)
+    assert [d["label"] for d in dets] == ["tv", "chair"]
+    assert dets[0]["box"] == [0.0, 0.0, 10.0, 10.0]

@@ -1,0 +1,53 @@
+"""Wire-contract tests for the /detect schemas (reference: schemas.py:6-32)."""
+
+import pytest
+from pydantic import ValidationError
+
+from spotter_tpu.schemas import (
+    DetectionErrorResult,
+    DetectionRequest,
+    DetectionResponse,
+    DetectionResult,
+    DetectionSuccessResult,
+)
+
+
+def test_request_validates_urls():
+    req = DetectionRequest.model_validate({"image_urls": ["http://example.com/a.jpg"]})
+    assert str(req.image_urls[0]) == "http://example.com/a.jpg"
+
+
+def test_request_rejects_non_urls():
+    with pytest.raises(ValidationError):
+        DetectionRequest.model_validate({"image_urls": ["not a url"]})
+
+
+def test_response_round_trip_mixed_results():
+    resp = DetectionResponse(
+        amenities_description="The property contains: TV, sofa.",
+        images=[
+            DetectionSuccessResult(
+                url="http://example.com/a.jpg",
+                detections=[DetectionResult(label="TV", box=[1.0, 2.0, 3.0, 4.0])],
+                labeled_image_base64="aGk=",
+            ),
+            DetectionErrorResult(url="http://example.com/b.jpg", error="HTTP Error: 404"),
+        ],
+    )
+    data = resp.model_dump()
+    assert data["images"][0]["detections"][0]["label"] == "TV"
+    assert data["images"][1]["error"].startswith("HTTP Error:")
+    # Wire shape must be exactly what chilir/spotter clients expect.
+    assert set(data.keys()) == {"amenities_description", "images"}
+    assert set(data["images"][0].keys()) == {"url", "detections", "labeled_image_base64"}
+    assert set(data["images"][1].keys()) == {"url", "error"}
+
+
+def test_taxonomy_contract():
+    from spotter_tpu.taxonomy import AMENITIES_MAPPING
+
+    assert len(AMENITIES_MAPPING) == 22
+    assert AMENITIES_MAPPING["couch"] == "sofa"
+    assert AMENITIES_MAPPING["tv"] == "TV"
+    assert AMENITIES_MAPPING["car"] == "parking"
+    assert "remote" not in AMENITIES_MAPPING
