@@ -1,0 +1,5 @@
+from spotter_tpu.convert.torch_to_jax import (  # noqa: F401
+    Rules,
+    convert_state_dict,
+    resnet_rules,
+)

@@ -1,0 +1,10 @@
+"""Flax model implementations for the spotter-tpu detection families.
+
+The reference serves arbitrary HF object-detection checkpoints via
+`AutoModelForObjectDetection` selected by env MODEL_NAME
+(apps/spotter/src/spotter/serve.py:199-205). Here each supported family is a
+TPU-first Flax implementation plus a torch->JAX weight converter; the registry
+in `spotter_tpu.models.registry` plays the AutoModel role.
+"""
+
+from spotter_tpu.models.registry import build_detector, MODEL_REGISTRY  # noqa: F401

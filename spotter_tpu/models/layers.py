@@ -1,0 +1,263 @@
+"""Shared Flax building blocks for the detection model families.
+
+Design notes (TPU-first):
+- NHWC layout everywhere; conv kernels HWIO (XLA's native TPU layout).
+- BatchNorms are "frozen": affine + running stats folded into 4 per-channel
+  params. This matches detection-serving practice (the torch lineage freezes
+  backbone BN: RTDetrV2FrozenBatchNorm2d / DetrFrozenBatchNorm2d) and keeps the
+  param tree a single pure-functional collection.
+- `dtype` on each module is the compute dtype (bf16 on TPU for the MXU);
+  params stay fp32.
+- Position tables, anchors, and sampling grids are computed with numpy at
+  trace time from static shapes, so XLA constant-folds them.
+"""
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+# Exact (erf) GELU to match torch's default nn.GELU / HF ACT2FN["gelu"].
+ACTIVATIONS: dict[str, Callable] = {
+    "relu": nn.relu,
+    "gelu": lambda x: nn.gelu(x, approximate=False),
+    "silu": nn.silu,
+    "swish": nn.silu,
+    "tanh": jnp.tanh,
+    "sigmoid": nn.sigmoid,
+    "quick_gelu": lambda x: x * nn.sigmoid(1.702 * x),
+}
+
+
+def get_activation(name: Optional[str]) -> Callable:
+    if name is None:
+        return lambda x: x
+    return ACTIVATIONS[name]
+
+
+def inverse_sigmoid(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    x = jnp.clip(x, 0.0, 1.0)
+    x1 = jnp.clip(x, eps, None)
+    x2 = jnp.clip(1.0 - x, eps, None)
+    return jnp.log(x1 / x2)
+
+
+class FrozenBatchNorm(nn.Module):
+    """Inference-mode batch norm: y = (x - mean) / sqrt(var + eps) * scale + bias.
+
+    Converted from torch BatchNorm2d running stats. Kept frozen during
+    fine-tuning (the DETR-family convention).
+    """
+
+    features: int
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param("scale", nn.initializers.ones, (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+        mean = self.param("mean", nn.initializers.zeros, (self.features,), jnp.float32)
+        var = self.param("var", nn.initializers.ones, (self.features,), jnp.float32)
+        # Fold into a single multiply-add (XLA fuses this into the preceding conv).
+        mul = scale * jax.lax.rsqrt(var + self.eps)
+        add = bias - mean * mul
+        return (x * mul.astype(self.dtype) + add.astype(self.dtype)).astype(self.dtype)
+
+
+class ConvNorm(nn.Module):
+    """Conv (no bias) + frozen BN + optional activation.
+
+    Equivalent of the torch ConvNormLayer used across the RT-DETR lineage
+    (conv k, stride s, padding (k-1)//2, bias=False, then BN, then act).
+    """
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    padding: Optional[int] = None
+    activation: Optional[str] = None
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        pad = (self.kernel_size - 1) // 2 if self.padding is None else self.padding
+        x = nn.Conv(
+            self.features,
+            (self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            padding=[(pad, pad), (pad, pad)],
+            use_bias=False,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        x = FrozenBatchNorm(self.features, eps=self.eps, dtype=self.dtype, name="bn")(x)
+        return get_activation(self.activation)(x)
+
+
+class MLPHead(nn.Module):
+    """DETR-style MLP prediction head: Linear stack with ReLU between layers."""
+
+    hidden_dim: int
+    out_dim: int
+    num_layers: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i in range(self.num_layers):
+            out = self.out_dim if i == self.num_layers - 1 else self.hidden_dim
+            x = nn.Dense(out, dtype=self.dtype, name=f"layer{i}")(x)
+            if i < self.num_layers - 1:
+                x = nn.relu(x)
+        return x
+
+
+class MultiHeadAttention(nn.Module):
+    """Standard MHA with separate q/k/v/out projections (torch-convertible).
+
+    DETR-lineage peculiarity: position embeddings are added to queries and keys
+    only — values come from the un-positioned hidden states.
+    """
+
+    embed_dim: int
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jnp.ndarray,
+        position_embeddings: Optional[jnp.ndarray] = None,
+        key_value_states: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        key_position_embeddings: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        head_dim = self.embed_dim // self.num_heads
+        q_in = hidden_states
+        if position_embeddings is not None:
+            q_in = hidden_states + position_embeddings
+        if key_value_states is None:  # self-attention
+            k_in, v_in = q_in, hidden_states
+        else:  # cross-attention
+            k_in = key_value_states
+            if key_position_embeddings is not None:
+                k_in = key_value_states + key_position_embeddings
+            v_in = key_value_states
+
+        def proj(x, name):
+            return nn.Dense(self.embed_dim, dtype=self.dtype, name=name)(x)
+
+        def split(x):
+            return x.reshape(*x.shape[:-1], self.num_heads, head_dim)
+
+        q = split(proj(q_in, "q_proj")) * (head_dim**-0.5)
+        k = split(proj(k_in, "k_proj"))
+        v = split(proj(v_in, "v_proj"))
+
+        # (B, H, Tq, Tk)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        if attention_mask is not None:
+            logits = logits + attention_mask.astype(logits.dtype)
+        weights = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        out = out.reshape(*out.shape[:-2], self.embed_dim)
+        return proj(out, "out_proj")
+
+
+def sincos_2d_position_embedding(
+    width: int, height: int, embed_dim: int, temperature: float = 10000.0
+) -> np.ndarray:
+    """AIFI 2D sin-cos table, (1, W*H, D) — computed in numpy from static shapes.
+
+    Grid is built with 'ij' indexing over (w, h), matching the RT-DETR hybrid
+    encoder's layout (tokens enumerate width-major after the flatten-permute).
+    """
+    if embed_dim % 4 != 0:
+        raise ValueError("embed_dim must be divisible by 4 for 2D sin-cos embeddings")
+    grid_w, grid_h = np.meshgrid(
+        np.arange(width, dtype=np.float32),
+        np.arange(height, dtype=np.float32),
+        indexing="ij",
+    )
+    pos_dim = embed_dim // 4
+    omega = 1.0 / (temperature ** (np.arange(pos_dim, dtype=np.float32) / pos_dim))
+    out_w = grid_w.reshape(-1)[:, None] * omega[None]
+    out_h = grid_h.reshape(-1)[:, None] * omega[None]
+    table = np.concatenate(
+        [np.sin(out_w), np.cos(out_w), np.sin(out_h), np.cos(out_h)], axis=1
+    )
+    return table[None].astype(np.float32)
+
+
+def sine_position_embedding_nhwc(
+    height: int,
+    width: int,
+    embed_dim: int,
+    temperature: float = 10000.0,
+    normalize: bool = True,
+    scale: float = 2.0 * math.pi,
+    eps: float = 1e-6,
+) -> np.ndarray:
+    """DETR-style interleaved sine position embedding, (1, H, W, D) numpy.
+
+    Matches DetrSinePositionEmbedding on an all-ones pixel mask: cumulative row
+    and column indices (1-based), optionally normalized to [0, scale].
+    """
+    half = embed_dim // 2
+    y = np.arange(1, height + 1, dtype=np.float32)[:, None].repeat(width, 1)
+    x = np.arange(1, width + 1, dtype=np.float32)[None, :].repeat(height, 0)
+    if normalize:
+        y = y / (y[-1:, :] + eps) * scale
+        x = x / (x[:, -1:] + eps) * scale
+    dim_t = temperature ** (2 * (np.arange(half, dtype=np.float32) // 2) / half)
+    pos_x = x[..., None] / dim_t
+    pos_y = y[..., None] / dim_t
+    pos_x = np.stack([np.sin(pos_x[..., 0::2]), np.cos(pos_x[..., 1::2])], axis=-1)
+    pos_y = np.stack([np.sin(pos_y[..., 0::2]), np.cos(pos_y[..., 1::2])], axis=-1)
+    pos_x = pos_x.reshape(height, width, half)
+    pos_y = pos_y.reshape(height, width, half)
+    return np.concatenate([pos_y, pos_x], axis=-1)[None].astype(np.float32)
+
+
+def grid_sample_bilinear_nhwc(value: jnp.ndarray, grid: jnp.ndarray) -> jnp.ndarray:
+    """Bilinear grid sample, align_corners=False, zeros padding — jnp/gather based.
+
+    value: (B, H, W, C); grid: (B, N, P, 2) in [-1, 1] with (x, y) order.
+    Returns (B, N, P, C). Semantics match torch.nn.functional.grid_sample so the
+    deformable-attention parity holds; implemented as 4 gathers + lerp, which XLA
+    lowers to efficient dynamic-gathers on TPU.
+    """
+    _, h, w, _ = value.shape
+    gx = (grid[..., 0] + 1.0) * w / 2.0 - 0.5
+    gy = (grid[..., 1] + 1.0) * h / 2.0 - 0.5
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        valid = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        flat = value.reshape(value.shape[0], h * w, value.shape[-1])
+        idx = yc * w + xc  # (B, N, P)
+        out = jnp.take_along_axis(
+            flat, idx.reshape(idx.shape[0], -1, 1), axis=1
+        ).reshape(*idx.shape, value.shape[-1])
+        return out * valid[..., None].astype(value.dtype)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx = wx[..., None].astype(value.dtype)
+    wy = wy[..., None].astype(value.dtype)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy

@@ -1,0 +1,36 @@
+"""Model registry: MODEL_NAME -> (config, Flax module, converter, pre/postprocess).
+
+Plays the role of `AutoModelForObjectDetection.from_pretrained(MODEL_NAME)` in
+the reference (serve.py:203-204). Families register themselves here; lookup is
+by HF repo-name substring so the same MODEL_NAME env values keep working.
+"""
+
+from dataclasses import dataclass
+from typing import Callable
+
+MODEL_REGISTRY: dict[str, "ModelFamily"] = {}
+
+
+@dataclass(frozen=True)
+class ModelFamily:
+    """Everything the engine needs to serve one architecture family."""
+
+    name: str
+    matches: tuple[str, ...]  # substrings of MODEL_NAME that select this family
+    build: Callable  # (model_name) -> BuiltDetector
+
+
+def register(family: ModelFamily) -> None:
+    MODEL_REGISTRY[family.name] = family
+
+
+def build_detector(model_name: str):
+    """Resolve MODEL_NAME to a built detector (module, params, specs)."""
+    key = model_name.lower()
+    for family in MODEL_REGISTRY.values():
+        if any(m in key for m in family.matches):
+            return family.build(model_name)
+    raise ValueError(
+        f"MODEL_NAME '{model_name}' does not match any registered family: "
+        f"{[f.matches for f in MODEL_REGISTRY.values()]}"
+    )

@@ -1,0 +1,150 @@
+"""Flax ResNet-D backbone (the RT-DETR "presnet" variant).
+
+Semantics match HF's RTDetrResNetBackbone (modeling_rt_detr_resnet.py): deep
+3-conv stem, max-pool, and — the "D" trick — 2x2 ceil-mode average pooling in
+front of 1x1 projection shortcuts when downsampling. NHWC layout, frozen BN.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from spotter_tpu.models.configs import ResNetConfig
+from spotter_tpu.models.layers import ConvNorm, get_activation
+
+
+def avg_pool_2x2_ceil(x: jnp.ndarray) -> jnp.ndarray:
+    """torch AvgPool2d(2, 2, ceil_mode=True): clipped edge windows divide by
+    their actual element count."""
+    b, h, w, c = x.shape
+    ph, pw = h % 2, w % 2
+    summed = nn.avg_pool(
+        x, (2, 2), strides=(2, 2), padding=((0, ph), (0, pw)), count_include_pad=False
+    )
+    return summed
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + residual (resnet-18/34)."""
+
+    out_channels: int
+    stride: int = 1
+    shortcut: str = "none"  # "none" | "proj" | "avgpool_proj"
+    hidden_act: str = "relu"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = ConvNorm(
+            self.out_channels, 3, self.stride, activation=self.hidden_act,
+            dtype=self.dtype, name="conv0",
+        )(x)
+        y = ConvNorm(self.out_channels, 3, 1, activation=None, dtype=self.dtype, name="conv1")(y)
+        if self.shortcut == "proj":
+            residual = ConvNorm(
+                self.out_channels, 1, self.stride, activation=None,
+                dtype=self.dtype, name="shortcut",
+            )(x)
+        elif self.shortcut == "avgpool_proj":
+            residual = avg_pool_2x2_ceil(x)
+            residual = ConvNorm(
+                self.out_channels, 1, 1, activation=None, dtype=self.dtype, name="shortcut"
+            )(residual)
+        return get_activation(self.hidden_act)(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand + residual (resnet-50/101)."""
+
+    out_channels: int
+    stride: int = 1
+    shortcut: str = "none"
+    downsample_in_bottleneck: bool = False
+    hidden_act: str = "relu"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        reduced = self.out_channels // 4
+        s1 = self.stride if self.downsample_in_bottleneck else 1
+        s2 = self.stride if not self.downsample_in_bottleneck else 1
+        y = ConvNorm(reduced, 1, s1, activation=self.hidden_act, dtype=self.dtype, name="conv0")(x)
+        y = ConvNorm(reduced, 3, s2, activation=self.hidden_act, dtype=self.dtype, name="conv1")(y)
+        y = ConvNorm(self.out_channels, 1, 1, activation=None, dtype=self.dtype, name="conv2")(y)
+        residual = x
+        if self.shortcut == "proj":
+            residual = ConvNorm(
+                self.out_channels, 1, self.stride, activation=None,
+                dtype=self.dtype, name="shortcut",
+            )(x)
+        elif self.shortcut == "avgpool_proj":
+            residual = avg_pool_2x2_ceil(x)
+            residual = ConvNorm(
+                self.out_channels, 1, 1, activation=None, dtype=self.dtype, name="shortcut"
+            )(residual)
+        elif self.shortcut == "avgpool":
+            residual = avg_pool_2x2_ceil(x)
+        return get_activation(self.hidden_act)(y + residual)
+
+
+def _basic_shortcut(in_ch: int, out_ch: int, stride: int, apply: bool) -> str:
+    # modeling_rt_detr_resnet.py RTDetrResNetBasicLayer.__init__ semantics
+    if in_ch != out_ch:
+        return "avgpool_proj" if apply else "none"
+    return "proj" if apply else "none"
+
+
+def _bottleneck_shortcut(in_ch: int, out_ch: int, stride: int) -> str:
+    # RTDetrResNetBottleNeckLayer.__init__: stride==2 always takes the avg-pool
+    # path (projection only when shapes change); stride==1 projects iff needed.
+    should_project = in_ch != out_ch or stride != 1
+    if stride == 2:
+        return "avgpool_proj" if should_project else "avgpool"
+    return "proj" if should_project else "none"
+
+
+class ResNetBackbone(nn.Module):
+    """Returns feature maps at `config.out_indices` of
+    (stem_out, stage1, stage2, stage3, stage4)."""
+
+    config: ResNetConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixel_values: jnp.ndarray) -> list[jnp.ndarray]:
+        cfg = self.config
+        act = cfg.hidden_act
+        x = pixel_values.astype(self.dtype)
+        # Deep stem: 3x3 s2 -> 3x3 -> 3x3, then 3x3 s2 max pool.
+        x = ConvNorm(cfg.embedding_size // 2, 3, 2, activation=act, dtype=self.dtype, name="stem0")(x)
+        x = ConvNorm(cfg.embedding_size // 2, 3, 1, activation=act, dtype=self.dtype, name="stem1")(x)
+        x = ConvNorm(cfg.embedding_size, 3, 1, activation=act, dtype=self.dtype, name="stem2")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        hidden_states = [x]
+        in_ch = cfg.embedding_size
+        for stage_idx, (out_ch, depth) in enumerate(zip(cfg.hidden_sizes, cfg.depths)):
+            stride = 2 if (stage_idx > 0 or cfg.downsample_in_first_stage) else 1
+            for block_idx in range(depth):
+                block_stride = stride if block_idx == 0 else 1
+                block_in = in_ch if block_idx == 0 else out_ch
+                name = f"stage{stage_idx}_block{block_idx}"
+                if cfg.layer_type == "bottleneck":
+                    shortcut = (
+                        _bottleneck_shortcut(block_in, out_ch, block_stride)
+                        if block_idx == 0
+                        else "none"
+                    )
+                    x = BottleneckBlock(
+                        out_ch, block_stride, shortcut, cfg.downsample_in_bottleneck,
+                        act, self.dtype, name=name,
+                    )(x)
+                else:
+                    shortcut = _basic_shortcut(block_in, out_ch, block_stride, block_idx == 0)
+                    x = BasicBlock(out_ch, block_stride, shortcut, act, self.dtype, name=name)(x)
+            hidden_states.append(x)
+            in_ch = out_ch
+
+        return [hidden_states[i] for i in cfg.out_indices]

@@ -1,0 +1,442 @@
+"""Flax RT-DETR / RT-DETRv2 detector — TPU-first implementation.
+
+Replaces the reference's torch `AutoModelForObjectDetection` forward
+(apps/spotter/src/spotter/serve.py:99-100) for MODEL_NAME values in the
+PekingU/rtdetr* family. Architecture semantics follow the published RT-DETRv2
+model (hybrid encoder with AIFI + CSP-RepVGG FPN/PAN; NMS-free deformable
+decoder with iterative box refinement), implemented in NHWC with static
+shapes so jit compiles once per input bucket:
+
+- anchors, sin-cos position tables, and per-level token spans are computed in
+  numpy at trace time from static spatial shapes — XLA constant-folds them;
+- multiscale deformable attention is a gather-based bilinear sample (see
+  layers.grid_sample_bilinear_nhwc), which XLA lowers to dynamic-gathers that
+  run well on TPU (no torch grid_sample / custom CUDA needed);
+- the whole forward is one jit region: backbone -> encoder -> decoder ->
+  (logits, boxes); no data-dependent control flow.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from spotter_tpu.models.configs import RTDetrConfig
+from spotter_tpu.models.layers import (
+    ConvNorm,
+    MLPHead,
+    MultiHeadAttention,
+    get_activation,
+    grid_sample_bilinear_nhwc,
+    inverse_sigmoid,
+    sincos_2d_position_embedding,
+)
+from spotter_tpu.models.resnet import ResNetBackbone
+
+
+def generate_anchors(
+    spatial_shapes: tuple[tuple[int, int], ...],
+    grid_size: float = 0.05,
+    eps: float = 1e-2,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Static anchor logits per multi-level grid cell.
+
+    Returns (anchors_logit (1, S, 4), valid_mask (1, S, 1)) in numpy; invalid
+    anchors get float32 max so sigmoid saturates at 1 (matching the torch
+    semantics of masking with finfo.max before sigmoid).
+    """
+    all_anchors = []
+    for level, (h, w) in enumerate(spatial_shapes):
+        gy, gx = np.meshgrid(
+            np.arange(h, dtype=np.float32), np.arange(w, dtype=np.float32), indexing="ij"
+        )
+        gxy = np.stack([gx, gy], axis=-1) + 0.5
+        gxy[..., 0] /= w
+        gxy[..., 1] /= h
+        wh = np.ones_like(gxy) * grid_size * (2.0**level)
+        all_anchors.append(np.concatenate([gxy, wh], -1).reshape(h * w, 4))
+    anchors = np.concatenate(all_anchors, 0)[None]
+    valid = ((anchors > eps) & (anchors < 1 - eps)).all(-1, keepdims=True)
+    anchors_logit = np.log(anchors / (1 - anchors))
+    anchors_logit = np.where(valid, anchors_logit, np.finfo(np.float32).max)
+    return anchors_logit.astype(np.float32), valid.astype(np.float32)
+
+
+class EncoderLayer(nn.Module):
+    """AIFI transformer encoder layer (post-norm)."""
+
+    embed_dim: int
+    num_heads: int
+    ffn_dim: int
+    activation: str = "gelu"
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, pos: Optional[jnp.ndarray]) -> jnp.ndarray:
+        attn_out = MultiHeadAttention(
+            self.embed_dim, self.num_heads, dtype=self.dtype, name="self_attn"
+        )(x, position_embeddings=pos)
+        x = nn.LayerNorm(epsilon=self.eps, dtype=self.dtype, name="self_attn_layer_norm")(
+            x + attn_out
+        )
+        y = nn.Dense(self.ffn_dim, dtype=self.dtype, name="fc1")(x)
+        y = get_activation(self.activation)(y)
+        y = nn.Dense(self.embed_dim, dtype=self.dtype, name="fc2")(y)
+        return nn.LayerNorm(epsilon=self.eps, dtype=self.dtype, name="final_layer_norm")(x + y)
+
+
+class RepVggBlock(nn.Module):
+    features: int
+    activation: str = "silu"
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        y = ConvNorm(self.features, 3, 1, padding=1, eps=self.eps, dtype=self.dtype, name="conv1")(x)
+        z = ConvNorm(self.features, 1, 1, padding=0, eps=self.eps, dtype=self.dtype, name="conv2")(x)
+        return get_activation(self.activation)(y + z)
+
+
+class CSPRepLayer(nn.Module):
+    """Cross-stage-partial fusion block with RepVGG bottlenecks."""
+
+    out_channels: int
+    hidden_channels: int
+    num_blocks: int = 3
+    activation: str = "silu"
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        h1 = ConvNorm(
+            self.hidden_channels, 1, 1, activation=self.activation, eps=self.eps,
+            dtype=self.dtype, name="conv1",
+        )(x)
+        for i in range(self.num_blocks):
+            h1 = RepVggBlock(
+                self.hidden_channels, self.activation, self.eps, self.dtype,
+                name=f"bottleneck{i}",
+            )(h1)
+        h2 = ConvNorm(
+            self.hidden_channels, 1, 1, activation=self.activation, eps=self.eps,
+            dtype=self.dtype, name="conv2",
+        )(x)
+        y = h1 + h2
+        if self.hidden_channels != self.out_channels:
+            y = ConvNorm(
+                self.out_channels, 1, 1, activation=self.activation, eps=self.eps,
+                dtype=self.dtype, name="conv3",
+            )(y)
+        return y
+
+
+class DeformableAttention(nn.Module):
+    """Multiscale deformable cross-attention (RT-DETRv2 semantics).
+
+    Sampling offsets are scaled by 1/n_points, the reference-box size, and
+    `offset_scale` (v2); sampling itself is bilinear ("default") or
+    nearest-integer ("discrete") over each level's value map.
+    """
+
+    d_model: int
+    num_heads: int
+    num_levels: int
+    num_points: int
+    offset_scale: float = 0.5
+    method: str = "default"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jnp.ndarray,  # (B, Q, D)
+        position_embeddings: Optional[jnp.ndarray],
+        encoder_hidden_states: jnp.ndarray,  # (B, S, D)
+        reference_points: jnp.ndarray,  # (B, Q, 4) normalized cxcywh
+        spatial_shapes: tuple[tuple[int, int], ...],
+    ) -> jnp.ndarray:
+        b, q, _ = hidden_states.shape
+        heads, levels, points = self.num_heads, self.num_levels, self.num_points
+        head_dim = self.d_model // heads
+        hs = hidden_states
+        if position_embeddings is not None:
+            hs = hs + position_embeddings
+
+        value = nn.Dense(self.d_model, dtype=self.dtype, name="value_proj")(
+            encoder_hidden_states
+        )
+        s = value.shape[1]
+        value = value.reshape(b, s, heads, head_dim)
+
+        offsets = nn.Dense(
+            heads * levels * points * 2, dtype=self.dtype, name="sampling_offsets"
+        )(hs).reshape(b, q, heads, levels * points, 2)
+        attn = nn.Dense(heads * levels * points, dtype=self.dtype, name="attention_weights")(
+            hs
+        ).reshape(b, q, heads, levels * points)
+        attn = nn.softmax(attn.astype(jnp.float32), axis=-1).astype(self.dtype)
+
+        # v2 offset semantics: offsets * (1/n_points) * ref_wh * offset_scale
+        n_points_scale = np.repeat(
+            1.0 / np.asarray([points] * levels, np.float32), points
+        )[None, None, None, :, None]
+        ref_xy = reference_points[:, :, None, None, :2]
+        ref_wh = reference_points[:, :, None, None, 2:]
+        loc = ref_xy + offsets * jnp.asarray(n_points_scale, self.dtype) * ref_wh * self.offset_scale
+        # loc: (B, Q, H, L*P, 2) in [0, 1]
+
+        sampled = []
+        start = 0
+        for lvl, (h, w) in enumerate(spatial_shapes):
+            v = value[:, start : start + h * w]  # (B, hw, heads, hd)
+            start += h * w
+            v = v.transpose(0, 2, 1, 3).reshape(b * heads, h, w, head_dim)
+            g = loc[:, :, :, lvl * points : (lvl + 1) * points, :]
+            g = g.transpose(0, 2, 1, 3, 4).reshape(b * heads, q, points, 2)
+            if self.method == "discrete":
+                wh_vec = jnp.asarray([w, h], self.dtype)
+                coord = jnp.floor(g * wh_vec + 0.5).astype(jnp.int32)
+                cx = jnp.clip(coord[..., 0], 0, w - 1)
+                cy = jnp.clip(coord[..., 1], 0, h - 1)
+                flat = v.reshape(b * heads, h * w, head_dim)
+                idx = (cy * w + cx).reshape(b * heads, -1, 1)
+                out = jnp.take_along_axis(flat, idx, axis=1).reshape(
+                    b * heads, q, points, head_dim
+                )
+            else:
+                out = grid_sample_bilinear_nhwc(v, 2.0 * g - 1.0)
+            sampled.append(out)
+        sampled = jnp.concatenate(sampled, axis=2)  # (B*H, Q, L*P, hd)
+
+        aw = attn.transpose(0, 2, 1, 3).reshape(b * heads, q, levels * points, 1)
+        out = (sampled * aw).sum(axis=2)  # (B*H, Q, hd)
+        out = out.reshape(b, heads, q, head_dim).transpose(0, 2, 1, 3).reshape(b, q, self.d_model)
+        return nn.Dense(self.d_model, dtype=self.dtype, name="output_proj")(out)
+
+
+class DecoderLayer(nn.Module):
+    config: RTDetrConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jnp.ndarray,
+        position_embeddings: jnp.ndarray,
+        encoder_hidden_states: jnp.ndarray,
+        reference_points: jnp.ndarray,
+        spatial_shapes: tuple[tuple[int, int], ...],
+        self_attention_mask: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        cfg = self.config
+        eps = cfg.layer_norm_eps
+        attn_out = MultiHeadAttention(
+            cfg.d_model, cfg.decoder_attention_heads, dtype=self.dtype, name="self_attn"
+        )(hidden_states, position_embeddings=position_embeddings,
+          attention_mask=self_attention_mask)
+        h = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="self_attn_layer_norm")(
+            hidden_states + attn_out
+        )
+        cross = DeformableAttention(
+            cfg.d_model,
+            cfg.decoder_attention_heads,
+            cfg.num_feature_levels,
+            cfg.decoder_n_points,
+            offset_scale=cfg.decoder_offset_scale,
+            method=cfg.decoder_method,
+            dtype=self.dtype,
+            name="encoder_attn",
+        )(h, position_embeddings, encoder_hidden_states, reference_points, spatial_shapes)
+        h = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="encoder_attn_layer_norm")(h + cross)
+        y = nn.Dense(cfg.decoder_ffn_dim, dtype=self.dtype, name="fc1")(h)
+        y = get_activation(cfg.decoder_activation_function)(y)
+        y = nn.Dense(cfg.d_model, dtype=self.dtype, name="fc2")(y)
+        return nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="final_layer_norm")(h + y)
+
+
+class RTDetrDetector(nn.Module):
+    """Full RT-DETR(v2) detector: pixels (B, H, W, 3) -> logits + boxes.
+
+    Returns a dict: logits (B, Q, C), pred_boxes (B, Q, 4) normalized cxcywh,
+    aux_logits/aux_boxes stacked over decoder layers (for training losses),
+    enc_topk_logits/enc_topk_bboxes (encoder auxiliary head).
+    """
+
+    config: RTDetrConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        pixel_values: jnp.ndarray,
+        decoder_input_queries: Optional[jnp.ndarray] = None,
+        decoder_input_ref_logits: Optional[jnp.ndarray] = None,
+        self_attention_mask: Optional[jnp.ndarray] = None,
+    ) -> dict:
+        cfg = self.config
+        feats = ResNetBackbone(cfg.backbone, dtype=self.dtype, name="backbone")(pixel_values)
+
+        proj = [
+            ConvNorm(
+                cfg.encoder_hidden_dim, 1, 1, activation=None, eps=cfg.batch_norm_eps,
+                dtype=self.dtype, name=f"enc_proj{i}",
+            )(f)
+            for i, f in enumerate(feats)
+        ]
+
+        # --- AIFI: transformer encoder on selected (stride-32) levels ---
+        for i, enc_ind in enumerate(cfg.encode_proj_layers):
+            b, h, w, c = proj[enc_ind].shape
+            src = proj[enc_ind].reshape(b, h * w, c)
+            pos = jnp.asarray(
+                sincos_2d_position_embedding(
+                    w, h, cfg.encoder_hidden_dim, cfg.positional_encoding_temperature
+                ),
+                self.dtype,
+            )
+            for j in range(cfg.encoder_layers):
+                src = EncoderLayer(
+                    cfg.encoder_hidden_dim,
+                    cfg.encoder_attention_heads,
+                    cfg.encoder_ffn_dim,
+                    cfg.encoder_activation_function,
+                    cfg.layer_norm_eps,
+                    self.dtype,
+                    name=f"aifi{i}_layer{j}",
+                )(src, pos)
+            proj[enc_ind] = src.reshape(b, h, w, c)
+
+        # --- top-down FPN ---
+        hidden_channels = int(cfg.encoder_hidden_dim * cfg.hidden_expansion)
+        num_stages = len(cfg.encoder_in_channels) - 1
+        fpn = [proj[-1]]
+        for idx in range(num_stages):
+            backbone_fm = proj[num_stages - idx - 1]
+            top = ConvNorm(
+                cfg.encoder_hidden_dim, 1, 1, activation=cfg.activation_function,
+                eps=cfg.batch_norm_eps, dtype=self.dtype, name=f"lateral_conv{idx}",
+            )(fpn[-1])
+            fpn[-1] = top
+            up = jnp.repeat(jnp.repeat(top, 2, axis=1), 2, axis=2)  # 2x nearest
+            fused = jnp.concatenate([up, backbone_fm], axis=-1)
+            fpn.append(
+                CSPRepLayer(
+                    cfg.encoder_hidden_dim, hidden_channels, cfg.csp_num_blocks,
+                    cfg.activation_function, cfg.batch_norm_eps, self.dtype,
+                    name=f"fpn_block{idx}",
+                )(fused)
+            )
+        fpn = fpn[::-1]
+
+        # --- bottom-up PAN ---
+        pan = [fpn[0]]
+        for idx in range(num_stages):
+            down = ConvNorm(
+                cfg.encoder_hidden_dim, 3, 2, activation=cfg.activation_function,
+                eps=cfg.batch_norm_eps, dtype=self.dtype, name=f"downsample_conv{idx}",
+            )(pan[-1])
+            fused = jnp.concatenate([down, fpn[idx + 1]], axis=-1)
+            pan.append(
+                CSPRepLayer(
+                    cfg.encoder_hidden_dim, hidden_channels, cfg.csp_num_blocks,
+                    cfg.activation_function, cfg.batch_norm_eps, self.dtype,
+                    name=f"pan_block{idx}",
+                )(fused)
+            )
+
+        # --- decoder input projection + flatten ---
+        sources = [
+            ConvNorm(
+                cfg.d_model, 1, 1, activation=None, eps=cfg.batch_norm_eps,
+                dtype=self.dtype, name=f"dec_proj{i}",
+            )(p)
+            for i, p in enumerate(pan)
+        ]
+        for i in range(len(sources), cfg.num_feature_levels):
+            sources.append(
+                ConvNorm(
+                    cfg.d_model, 3, 2, padding=1, activation=None, eps=cfg.batch_norm_eps,
+                    dtype=self.dtype, name=f"dec_proj{i}",
+                )(sources[-1])
+            )
+
+        spatial_shapes = tuple((s.shape[1], s.shape[2]) for s in sources)
+        b = sources[0].shape[0]
+        source_flatten = jnp.concatenate(
+            [s.reshape(b, -1, cfg.d_model) for s in sources], axis=1
+        )
+
+        # --- encoder head: anchor scoring + top-k query selection ---
+        anchors_np, valid_np = generate_anchors(spatial_shapes, cfg.anchor_grid_size)
+        anchors = jnp.asarray(anchors_np, self.dtype)
+        valid_mask = jnp.asarray(valid_np, self.dtype)
+
+        memory = valid_mask * source_flatten
+        output_memory = nn.Dense(cfg.d_model, dtype=self.dtype, name="enc_output_dense")(memory)
+        output_memory = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="enc_output_norm"
+        )(output_memory)
+
+        enc_class = nn.Dense(cfg.num_labels, dtype=self.dtype, name="enc_score_head")(
+            output_memory
+        )
+        enc_coord_logits = (
+            MLPHead(cfg.d_model, 4, 3, dtype=self.dtype, name="enc_bbox_head")(output_memory)
+            + anchors
+        )
+
+        _, topk_ind = jax.lax.top_k(enc_class.max(-1), cfg.num_queries)
+        gather = lambda arr: jnp.take_along_axis(arr, topk_ind[..., None], axis=1)
+        reference_logits = gather(enc_coord_logits)
+        enc_topk_logits = gather(enc_class)
+        enc_topk_bboxes = nn.sigmoid(reference_logits)
+
+        if cfg.learn_initial_query:
+            target = self.param(
+                "query_embed", nn.initializers.normal(1.0), (cfg.num_queries, cfg.d_model)
+            )
+            target = jnp.broadcast_to(target, (b, cfg.num_queries, cfg.d_model)).astype(self.dtype)
+        else:
+            target = jax.lax.stop_gradient(gather(output_memory))
+
+        reference_logits = jax.lax.stop_gradient(reference_logits)
+
+        # Denoising groups (training) enter here as extra queries.
+        if decoder_input_queries is not None:
+            target = jnp.concatenate([decoder_input_queries, target], axis=1)
+            reference_logits = jnp.concatenate(
+                [decoder_input_ref_logits, reference_logits], axis=1
+            )
+
+        # --- decoder with iterative refinement ---
+        ref = nn.sigmoid(reference_logits)
+        h = target
+        query_pos_head = MLPHead(
+            2 * cfg.d_model, cfg.d_model, 2, dtype=self.dtype, name="query_pos_head"
+        )
+        aux_logits, aux_boxes = [], []
+        for i in range(cfg.decoder_layers):
+            pos = query_pos_head(ref)
+            h = DecoderLayer(cfg, dtype=self.dtype, name=f"decoder_layer{i}")(
+                h, pos, source_flatten, ref, spatial_shapes, self_attention_mask
+            )
+            box_delta = MLPHead(cfg.d_model, 4, 3, dtype=self.dtype, name=f"bbox_head{i}")(h)
+            new_ref = nn.sigmoid(box_delta + inverse_sigmoid(ref))
+            logits_i = nn.Dense(cfg.num_labels, dtype=self.dtype, name=f"class_head{i}")(h)
+            aux_logits.append(logits_i)
+            aux_boxes.append(new_ref)
+            ref = jax.lax.stop_gradient(new_ref)
+
+        return {
+            "logits": aux_logits[-1],
+            "pred_boxes": aux_boxes[-1],
+            "aux_logits": jnp.stack(aux_logits, axis=1),
+            "aux_boxes": jnp.stack(aux_boxes, axis=1),
+            "enc_topk_logits": enc_topk_logits,
+            "enc_topk_bboxes": enc_topk_bboxes,
+        }

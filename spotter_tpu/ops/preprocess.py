@@ -49,9 +49,12 @@ class PreprocessSpec:
 
 
 RTDETR_SPEC = PreprocessSpec(mode="fixed", size=(640, 640))
+# Bucket must cover both orientations: a portrait image resizes to up to
+# (1333, 800), landscape to (800, 1333). The serving engine narrows this to
+# per-orientation buckets; the static spec must hold any legal resize.
 DETR_SPEC = PreprocessSpec(
     mode="shortest_edge", size=(800, 1333), mean=IMAGENET_MEAN, std=IMAGENET_STD,
-    pad_to=(800, 1333),
+    pad_to=(1333, 1333),
 )
 OWLVIT_SPEC = PreprocessSpec(mode="fixed", size=(768, 768), mean=CLIP_MEAN, std=CLIP_STD)
 
@@ -92,7 +95,7 @@ def preprocess_image(
         raise ValueError(f"Unknown preprocess mode: {spec.mode}")
 
     arr = arr * spec.rescale_factor
-    if spec.mean is not None:
+    if spec.mean is not None and spec.std is not None:
         arr = (arr - np.asarray(spec.mean, dtype=np.float32)) / np.asarray(
             spec.std, dtype=np.float32
         )

@@ -9,15 +9,40 @@ must be set before jax is first imported, hence module scope here.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU (the session env may point JAX at a real TPU; tests must be
+# hermetic and run the virtual 8-device mesh).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Surface NaNs produced inside jit in tests (SURVEY.md §5.2).
+# NaN checking is off by default (it disables some fusions and slows the
+# 1-core CPU runs); individual numerical tests opt in via
+# jax.config.update("jax_debug_nans", True).
 os.environ.setdefault("JAX_DEBUG_NANS", "False")
+# Parity tests compare against fp32 torch; JAX's CPU backend defaults to a
+# lower-precision oneDNN path (~1e-2 drift per conv), so pin full precision.
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
+
+# The session interpreter may carry a TPU-tunnel PJRT plugin ("axon") whose
+# registration hook initializes the remote backend from ANY jax process — and
+# hangs every test when the tunnel is unhealthy. Tests are CPU-only by design;
+# drop the plugin factory and its discovery env before the first backend init.
+for _var in ("PJRT_LIBRARY_PATH", "PJRT_NAMES_AND_LIBRARY_PATHS"):
+    os.environ.pop(_var, None)
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    # The session interpreter imports jax at startup (sitecustomize), so the
+    # env vars above may be read already — set the live config too.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+except Exception:
+    pass

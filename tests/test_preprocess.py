@@ -1,0 +1,51 @@
+import numpy as np
+from PIL import Image
+
+from spotter_tpu.ops.preprocess import (
+    DETR_SPEC,
+    RTDETR_SPEC,
+    PreprocessSpec,
+    batch_images,
+    preprocess_image,
+    shortest_edge_size,
+)
+
+
+def _img(h, w, seed=0):
+    rng = np.random.default_rng(seed)
+    return Image.fromarray(rng.integers(0, 255, size=(h, w, 3), dtype=np.uint8))
+
+
+def test_fixed_spec_shape_and_range():
+    arr, mask, orig = preprocess_image(_img(480, 640), RTDETR_SPEC)
+    assert arr.shape == (640, 640, 3)
+    assert orig == (480, 640)
+    assert mask.all()
+    assert 0.0 <= arr.min() and arr.max() <= 1.0  # rescale only, no normalize
+
+
+def test_shortest_edge_size_caps_long_side():
+    assert shortest_edge_size((480, 640), 800, 1333) == (800, 1067)
+    # long side would exceed the cap -> scale by the long side instead
+    assert shortest_edge_size((500, 2000), 800, 1333) == (333, 1333)
+
+
+def test_detr_spec_landscape_and_portrait_fit_bucket():
+    for h, w in [(480, 640), (1000, 500), (640, 480), (2000, 500)]:
+        arr, mask, _ = preprocess_image(_img(h, w), DETR_SPEC)
+        assert arr.shape == (*DETR_SPEC.input_hw, 3)
+        # mask marks the valid region only
+        assert 0 < mask.sum() <= mask.size
+
+
+def test_normalization_applies_mean_std():
+    spec = PreprocessSpec(mode="fixed", size=(32, 32), mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
+    arr, _, _ = preprocess_image(_img(32, 32), spec)
+    assert arr.min() >= -1.0 - 1e-6 and arr.max() <= 1.0 + 1e-6
+
+
+def test_batch_images_stacks_and_sizes():
+    pixels, masks, sizes = batch_images([_img(480, 640), _img(100, 200, 1)], RTDETR_SPEC)
+    assert pixels.shape == (2, 640, 640, 3)
+    assert masks.shape == (2, 640, 640)
+    np.testing.assert_array_equal(sizes, [[480, 640], [100, 200]])
