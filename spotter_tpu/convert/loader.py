@@ -1,0 +1,77 @@
+"""Checkpoint loading: HF torch checkpoint -> (config, Flax params), with an
+Orbax cache so torch is only needed the first time.
+
+Plays the role of the reference's weight-baking flow (download.py +
+from_pretrained at serve.py:203): `spotter-tpu-download` pre-converts at image
+build; pod start loads the converted Orbax checkpoint directly.
+"""
+
+import logging
+import os
+from pathlib import Path
+
+import numpy as np
+
+from spotter_tpu.models.configs import RTDetrConfig
+
+logger = logging.getLogger(__name__)
+
+CACHE_ENV = "SPOTTER_TPU_CACHE"
+DEFAULT_CACHE = "~/.cache/spotter_tpu"
+
+
+def cache_dir() -> Path:
+    return Path(os.environ.get(CACHE_ENV, DEFAULT_CACHE)).expanduser()
+
+
+def _cache_path(model_name: str) -> Path:
+    return cache_dir() / model_name.replace("/", "--")
+
+
+def _save_cache(path: Path, params: dict) -> None:
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path.absolute() / "params", params, force=True)
+        ckptr.wait_until_finished()
+    except Exception:  # cache is best-effort; serving works without it
+        logger.exception("Failed to write param cache at %s", path)
+
+
+def _load_cache(path: Path):
+    if not (path / "params").exists():
+        return None
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(path.absolute() / "params")
+    except Exception:
+        logger.exception("Failed to read param cache at %s", path)
+        return None
+
+
+def load_rtdetr_from_hf(model_name: str) -> tuple[RTDetrConfig, dict]:
+    """Load + convert an RT-DETR(v2) checkpoint; Orbax-cached per MODEL_NAME."""
+    from transformers import AutoConfig
+
+    hf_cfg = AutoConfig.from_pretrained(model_name)
+    cfg = RTDetrConfig.from_hf(hf_cfg)
+
+    cached = _load_cache(_cache_path(model_name))
+    if cached is not None:
+        logger.info("Loaded converted params for %s from cache", model_name)
+        return cfg, cached
+
+    import torch  # local import: only needed for first-time conversion
+    from transformers import AutoModelForObjectDetection
+
+    from spotter_tpu.convert.rtdetr_rules import rtdetr_rules
+    from spotter_tpu.convert.torch_to_jax import convert_state_dict
+
+    with torch.no_grad():
+        model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
+    params = convert_state_dict(model.state_dict(), rtdetr_rules(cfg), strict=False)
+    _save_cache(_cache_path(model_name), params)
+    return cfg, params

@@ -1,0 +1,3 @@
+from spotter_tpu.engine.engine import InferenceEngine  # noqa: F401
+from spotter_tpu.engine.batcher import MicroBatcher  # noqa: F401
+from spotter_tpu.engine.metrics import Metrics  # noqa: F401

@@ -1,0 +1,77 @@
+"""Async micro-batcher: many concurrent requests -> few big device batches.
+
+The reference fans out per image with asyncio.gather and runs batch-size-1
+forwards (serve.py:98-109, 180-181) — fine on CPU, starves a TPU. Here each
+request submits images to a shared queue; a single pump task drains up to
+max_batch images or waits at most max_delay_ms, then runs the engine in a
+worker thread (device work releases the GIL). Per-image error containment is
+preserved: a failed batch rejects only its own futures.
+"""
+
+import asyncio
+import time
+from typing import Optional
+
+from PIL import Image
+
+from spotter_tpu.engine.engine import InferenceEngine
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        max_batch: Optional[int] = None,
+        max_delay_ms: float = 5.0,
+    ) -> None:
+        self.engine = engine
+        self.max_batch = max_batch or engine.batch_buckets[-1]
+        self.max_delay_s = max_delay_ms / 1000.0
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._pump_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        if self._pump_task is None:
+            self._pump_task = asyncio.create_task(self._pump())
+
+    async def stop(self) -> None:
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            try:
+                await self._pump_task
+            except asyncio.CancelledError:
+                pass
+            self._pump_task = None
+
+    async def submit(self, image: Image.Image) -> list[dict]:
+        """One image in, its detections out (awaits the batched device call)."""
+        await self.start()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((image, fut))
+        return await fut
+
+    async def _pump(self) -> None:
+        while True:
+            image, fut = await self._queue.get()
+            batch = [(image, fut)]
+            deadline = time.monotonic() + self.max_delay_s
+            while len(batch) < self.max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(self._queue.get(), timeout))
+                except asyncio.TimeoutError:
+                    break
+            images = [b[0] for b in batch]
+            try:
+                results = await asyncio.to_thread(self.engine.detect, images)
+            except Exception as exc:  # contain failure to this batch only
+                self.engine.metrics.record_error(len(batch))
+                for _, f in batch:
+                    if not f.done():
+                        f.set_exception(exc)
+                continue
+            for (_, f), dets in zip(batch, results):
+                if not f.done():
+                    f.set_result(dets)

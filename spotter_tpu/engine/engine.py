@@ -1,0 +1,146 @@
+"""TPU inference engine: static-shape buckets, padded batching, jit cache.
+
+This is the device half of what `serve.py:98-109` does per image in the
+reference — but batched and shape-disciplined for XLA:
+
+- batch sizes come from a fixed ladder (pad up to the next bucket), so the
+  number of compiled programs is bounded (SURVEY.md §5.7);
+- preprocess produces one static (H, W) per model family;
+- postprocess returns fixed-k tensors on device; thresholding happens on host.
+
+The engine is synchronous (one device stream); `MicroBatcher` feeds it from
+async request handlers.
+"""
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from PIL import Image
+
+from spotter_tpu.engine.metrics import Metrics
+from spotter_tpu.ops.postprocess import (
+    sigmoid_max_postprocess,
+    sigmoid_topk_postprocess,
+    softmax_postprocess,
+    to_detections,
+)
+from spotter_tpu.ops.preprocess import PreprocessSpec, batch_images
+
+POSTPROCESS_KINDS = {
+    "sigmoid_topk": sigmoid_topk_postprocess,      # RT-DETR family
+    "softmax": softmax_postprocess,                # DETR / YOLOS
+    "sigmoid_max": sigmoid_max_postprocess,        # OWL-ViT
+}
+
+
+@dataclass
+class BuiltDetector:
+    """Everything the engine needs for one loaded model (registry output)."""
+
+    model_name: str
+    module: object  # flax module with .apply
+    params: dict
+    preprocess_spec: PreprocessSpec
+    postprocess: str  # key into POSTPROCESS_KINDS
+    id2label: dict[int, str]
+    num_top_queries: int = 300
+    # extra static kwargs passed to module.apply (e.g. OWL-ViT text inputs)
+    apply_kwargs: dict = field(default_factory=dict)
+
+
+def default_batch_buckets(max_batch: int = 8) -> tuple[int, ...]:
+    sizes = []
+    b = 1
+    while b <= max_batch:
+        sizes.append(b)
+        b *= 2
+    return tuple(sizes)
+
+
+class InferenceEngine:
+    """Owns device params + compiled programs; turns PIL images into detections."""
+
+    def __init__(
+        self,
+        built: BuiltDetector,
+        threshold: float = 0.5,
+        batch_buckets: Sequence[int] = (1, 2, 4, 8),
+        device: Optional[jax.Device] = None,
+        metrics: Optional[Metrics] = None,
+        donate_pixels: bool = True,
+    ) -> None:
+        self.built = built
+        self.threshold = threshold
+        self.batch_buckets = tuple(sorted(batch_buckets))
+        self.device = device or jax.devices()[0]
+        self.metrics = metrics or Metrics()
+        self.params = jax.device_put(built.params, self.device)
+        post_fn = POSTPROCESS_KINDS[built.postprocess]
+        k = built.num_top_queries
+
+        def forward(params, pixels, target_sizes):
+            out = built.module.apply({"params": params}, pixels, **built.apply_kwargs)
+            if built.postprocess == "sigmoid_topk":
+                kk = min(k, out["logits"].shape[1] * out["logits"].shape[2])
+                return sigmoid_topk_postprocess(
+                    out["logits"], out["pred_boxes"], target_sizes, k=kk
+                )
+            return post_fn(out["logits"], out["pred_boxes"], target_sizes)
+
+        # One compiled program per batch bucket; jit caches by shape.
+        self._forward = jax.jit(forward)
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        return self.batch_buckets[-1]
+
+    def warmup(self) -> None:
+        """Compile every bucket ahead of traffic (first compile is slow)."""
+        h, w = self.built.preprocess_spec.input_hw
+        for b in self.batch_buckets:
+            pixels = jnp.zeros((b, h, w, 3), jnp.float32)
+            sizes = jnp.ones((b, 2), jnp.float32)
+            jax.block_until_ready(self._forward(self.params, pixels, sizes))
+
+    def detect(self, images: list[Image.Image]) -> list[list[dict]]:
+        """PIL images -> per-image lists of {"label", "score", "box"} dicts.
+
+        Splits into bucket-sized chunks, pads the tail, strips pad results.
+        """
+        results: list[list[dict]] = []
+        i = 0
+        max_b = self.batch_buckets[-1]
+        while i < len(images):
+            chunk = images[i : i + max_b]
+            results.extend(self._detect_chunk(chunk))
+            i += max_b
+        return results
+
+    def _detect_chunk(self, images: list[Image.Image]) -> list[list[dict]]:
+        t0 = time.monotonic()
+        n = len(images)
+        bucket = self.bucket_for(n)
+        pixels, _, sizes = batch_images(images, self.built.preprocess_spec)
+        if bucket > n:  # pad batch to the static bucket size
+            pad = bucket - n
+            pixels = np.concatenate([pixels, np.zeros((pad, *pixels.shape[1:]), pixels.dtype)])
+            sizes = np.concatenate([sizes, np.ones((pad, 2), sizes.dtype)])
+        scores, labels, boxes = self._forward(
+            self.params, jnp.asarray(pixels), jnp.asarray(sizes)
+        )
+        scores, labels, boxes = jax.device_get((scores, labels, boxes))
+        out = [
+            to_detections(
+                scores[j], labels[j], boxes[j], self.built.id2label, self.threshold
+            )
+            for j in range(n)
+        ]
+        self.metrics.record_batch(n, time.monotonic() - t0)
+        return out

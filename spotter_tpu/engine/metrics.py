@@ -1,0 +1,65 @@
+"""Serving metrics: images/sec and latency percentiles.
+
+The reference has no metrics endpoint (SURVEY.md §5.5); the north-star targets
+(BASELINE.md: >=2000 img/s, p50 < 40 ms) make them mandatory here. Lock-light
+counters + a bounded reservoir; snapshot() is what /metrics serves.
+"""
+
+import threading
+import time
+from collections import deque
+
+
+class Metrics:
+    def __init__(self, window: int = 2048) -> None:
+        self._lock = threading.Lock()
+        self._latencies_ms: deque[float] = deque(maxlen=window)
+        self._images_total = 0
+        self._errors_total = 0
+        self._batches_total = 0
+        self._batch_sizes: deque[int] = deque(maxlen=window)
+        self._started = time.monotonic()
+        self._window_start = time.monotonic()
+        self._window_images = 0
+
+    def record_batch(self, batch_size: int, latency_s: float) -> None:
+        with self._lock:
+            self._images_total += batch_size
+            self._window_images += batch_size
+            self._batches_total += 1
+            self._batch_sizes.append(batch_size)
+            self._latencies_ms.append(latency_s * 1000.0)
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self._errors_total += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lats = sorted(self._latencies_ms)
+            now = time.monotonic()
+            window_s = max(now - self._window_start, 1e-9)
+            images_per_sec = self._window_images / window_s
+            # roll the throughput window so the rate tracks recent load
+            if window_s > 30.0:
+                self._window_start = now
+                self._window_images = 0
+
+            def pct(p: float) -> float:
+                if not lats:
+                    return 0.0
+                return lats[min(int(p * len(lats)), len(lats) - 1)]
+
+            return {
+                "images_total": self._images_total,
+                "errors_total": self._errors_total,
+                "batches_total": self._batches_total,
+                "mean_batch_size": (
+                    sum(self._batch_sizes) / len(self._batch_sizes) if self._batch_sizes else 0.0
+                ),
+                "images_per_sec": images_per_sec,
+                "latency_ms_p50": pct(0.50),
+                "latency_ms_p90": pct(0.90),
+                "latency_ms_p99": pct(0.99),
+                "uptime_s": now - self._started,
+            }

@@ -26,6 +26,10 @@ def register(family: ModelFamily) -> None:
 
 def build_detector(model_name: str):
     """Resolve MODEL_NAME to a built detector (module, params, specs)."""
+    # Lazy: zoo pulls in the engine (jax/PIL); config-only consumers of
+    # spotter_tpu.models must not pay that import.
+    from spotter_tpu.models import zoo  # noqa: F401  (self-registers families)
+
     key = model_name.lower()
     for family in MODEL_REGISTRY.values():
         if any(m in key for m in family.matches):

@@ -1,0 +1,86 @@
+"""Model zoo: loaders that turn a MODEL_NAME into a BuiltDetector.
+
+The loading boundary mirrors the reference's
+`AutoModelForObjectDetection.from_pretrained(MODEL_NAME)` (serve.py:203):
+torch weights come from the local HF cache (baked into the serving image the
+way the reference bakes them — Dockerfile:17, download.py), get converted to
+Flax params once, and are cached as an Orbax checkpoint keyed by MODEL_NAME
+so later pod starts skip torch entirely.
+
+Offline/test path: SPOTTER_TPU_TINY=1 builds a tiny random-init model (no
+network, no torch) — the serving stack's equivalent of the reference tests'
+MagicMock model (test_serve.py:24-28), but running the real engine.
+"""
+
+import logging
+import os
+
+import jax
+import numpy as np
+
+from spotter_tpu.engine.engine import BuiltDetector
+from spotter_tpu.models.coco import coco_id2label_80
+from spotter_tpu.models.configs import RESNET_PRESETS, ResNetConfig, RTDetrConfig
+from spotter_tpu.models.registry import ModelFamily, register
+from spotter_tpu.models.rtdetr import RTDetrDetector
+from spotter_tpu.ops.preprocess import RTDETR_SPEC, PreprocessSpec
+
+logger = logging.getLogger(__name__)
+
+TINY_ENV = "SPOTTER_TPU_TINY"
+
+
+def tiny_rtdetr_config(num_labels: int = 80) -> RTDetrConfig:
+    return RTDetrConfig(
+        backbone=ResNetConfig(
+            embedding_size=16, hidden_sizes=(16, 24, 32, 48), depths=(1, 1, 1, 1),
+            layer_type="basic",
+        ),
+        num_labels=num_labels,
+        d_model=32,
+        num_queries=30,
+        encoder_hidden_dim=32,
+        encoder_in_channels=(24, 32, 48),
+        encoder_ffn_dim=48,
+        decoder_ffn_dim=48,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        decoder_layers=2,
+        decoder_n_points=2,
+        id2label=tuple(coco_id2label_80().items()),
+    )
+
+
+def _init_random(module, input_hw: tuple[int, int]) -> dict:
+    h, w = input_hw
+    variables = module.init(jax.random.PRNGKey(0), np.zeros((1, h, w, 3), np.float32))
+    return variables["params"]
+
+
+def _build_rtdetr(model_name: str) -> BuiltDetector:
+    if os.environ.get(TINY_ENV):
+        cfg = tiny_rtdetr_config()
+        spec = PreprocessSpec(mode="fixed", size=(64, 64))
+        module = RTDetrDetector(cfg)
+        params = _init_random(module, spec.input_hw)
+        logger.info("Built tiny random RT-DETR for %s (%s)", model_name, TINY_ENV)
+    else:
+        from spotter_tpu.convert.loader import load_rtdetr_from_hf  # lazy: needs torch
+
+        cfg, params = load_rtdetr_from_hf(model_name)
+        spec = RTDETR_SPEC
+        module = RTDetrDetector(cfg)
+    return BuiltDetector(
+        model_name=model_name,
+        module=module,
+        params=params,
+        preprocess_spec=spec,
+        postprocess="sigmoid_topk",
+        id2label=cfg.id2label_dict,
+        num_top_queries=min(300, cfg.num_queries),
+    )
+
+
+register(
+    ModelFamily(name="rtdetr", matches=("rtdetr", "rt_detr", "rt-detr"), build=_build_rtdetr)
+)

@@ -78,27 +78,33 @@ def preprocess_image(
     (non-pad) pixels, the analog of HF DETR's pixel_mask.
     """
     orig_hw = (image.height, image.width)
+
+    def rescale_normalize(a: np.ndarray) -> np.ndarray:
+        a = a * spec.rescale_factor
+        if spec.mean is not None and spec.std is not None:
+            a = (a - np.asarray(spec.mean, dtype=np.float32)) / np.asarray(
+                spec.std, dtype=np.float32
+            )
+        return a
+
     if spec.mode == "fixed":
         th, tw = spec.size
         resized = image.resize((tw, th), resample=Image.BILINEAR)
-        arr = np.asarray(resized, dtype=np.float32)
+        arr = rescale_normalize(np.asarray(resized, dtype=np.float32))
         mask = np.ones((th, tw), dtype=np.float32)
     elif spec.mode == "shortest_edge":
         rh, rw = shortest_edge_size(orig_hw, spec.size[0], spec.size[1])
         resized = image.resize((rw, rh), resample=Image.BILINEAR)
         ph, pw = spec.input_hw
+        # Normalize BEFORE padding: pad pixels must be exactly 0 (the torch
+        # DETR processor pads after normalization; checkpoints expect 0 pads).
         arr = np.zeros((ph, pw, 3), dtype=np.float32)
-        arr[:rh, :rw] = np.asarray(resized, dtype=np.float32)
+        arr[:rh, :rw] = rescale_normalize(np.asarray(resized, dtype=np.float32))
         mask = np.zeros((ph, pw), dtype=np.float32)
         mask[:rh, :rw] = 1.0
     else:
         raise ValueError(f"Unknown preprocess mode: {spec.mode}")
 
-    arr = arr * spec.rescale_factor
-    if spec.mean is not None and spec.std is not None:
-        arr = (arr - np.asarray(spec.mean, dtype=np.float32)) / np.asarray(
-            spec.std, dtype=np.float32
-        )
     return arr, mask, orig_hw
 
 

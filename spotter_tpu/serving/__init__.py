@@ -1,0 +1,1 @@
+from spotter_tpu.serving.detector import AmenitiesDetector  # noqa: F401

@@ -1,0 +1,65 @@
+"""Serving bootstrap: MODEL_NAME env -> engine -> detector (+ Ray adapter).
+
+Mirrors the reference's module-import bootstrap (serve.py:199-205): MODEL_NAME
+is required and raises if unset; the built app object is what the RayService
+manifest names as import_path (rayservice-template.yaml:8-9).
+
+Ray Serve is optional in this build (it is the production fabric when
+installed — reference pyproject.toml:11 — but the framework degrades to the
+standalone aiohttp server, and tests never need Ray, matching the reference's
+own practice of testing the undecorated class: test_serve.py:32).
+"""
+
+import os
+
+from spotter_tpu.engine.batcher import MicroBatcher
+from spotter_tpu.engine.engine import InferenceEngine
+from spotter_tpu.models import build_detector
+from spotter_tpu.serving.detector import AmenitiesDetector
+
+DETECTION_THRESHOLD = 0.5  # serve.py:107
+
+
+def build_detector_app(
+    model_name: str | None = None,
+    threshold: float = DETECTION_THRESHOLD,
+    batch_buckets: tuple[int, ...] = (1, 2, 4, 8),
+    max_delay_ms: float = 5.0,
+    warmup: bool = False,
+) -> AmenitiesDetector:
+    model_name = model_name or os.environ.get("MODEL_NAME")
+    if not model_name:
+        raise ValueError("MODEL_NAME environment variable not set.")
+    built = build_detector(model_name)
+    engine = InferenceEngine(built, threshold=threshold, batch_buckets=batch_buckets)
+    if warmup:
+        engine.warmup()
+    batcher = MicroBatcher(engine, max_delay_ms=max_delay_ms)
+    return AmenitiesDetector(engine, batcher)
+
+
+def ray_deployment():
+    """Ray Serve deployment graph node (the manifest's import_path target)."""
+    from ray import serve
+    from starlette.requests import Request
+
+    @serve.deployment
+    class RayAmenitiesDetector:
+        def __init__(self, model_name: str) -> None:
+            self._inner = build_detector_app(model_name, warmup=True)
+
+        async def __call__(self, raw_payload: "Request"):
+            return await self._inner.detect(await raw_payload.json())
+
+    model_name = os.environ.get("MODEL_NAME")
+    if not model_name:
+        raise ValueError("MODEL_NAME environment variable not set.")
+    return RayAmenitiesDetector.bind(model_name)
+
+
+try:  # module-level `deployment` preserved for manifest import_path parity
+    import ray  # noqa: F401
+
+    deployment = ray_deployment()
+except Exception:  # Ray not installed / not initialized — standalone mode
+    deployment = None

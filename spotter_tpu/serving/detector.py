@@ -1,0 +1,130 @@
+"""AmenitiesDetector: fetch -> detect -> draw -> encode, per-image error containment.
+
+Behavior contract with the reference detector (serve.py:64-196), observable
+bit-for-bit at the /detect wire:
+- async URL fetch with tenacity retry (3 attempts, exponential backoff
+  multiplier 1, min 4 s, max 10 s, reraise) — serve.py:84-91
+- PIL open + convert("RGB") — serve.py:96-97
+- detections filtered through AMENITIES_MAPPING; irrelevant labels dropped —
+  serve.py:123-126
+- red box width 3, amenity text at (x+5, y+5), white fill / black stroke —
+  serve.py:127-134
+- JPEG + base64 of the annotated image — serve.py:139-142
+- httpx errors -> "HTTP Error: ..."; anything else -> "Processing Error: ..."
+  with traceback; one bad URL never fails the batch — serve.py:150-157
+- response joins detected amenities into "The property contains: ..." /
+  "No relevant amenities detected." — serve.py:190-194
+
+The difference is under the hood: detection goes through the MicroBatcher into
+the jit-compiled TPU engine instead of a per-image torch forward.
+"""
+
+import asyncio
+import base64
+import traceback
+from io import BytesIO
+
+import httpx
+from PIL import Image, ImageDraw
+from tenacity import AsyncRetrying, stop_after_attempt, wait_exponential
+
+from spotter_tpu.engine.batcher import MicroBatcher
+from spotter_tpu.engine.engine import InferenceEngine
+from spotter_tpu.schemas import (
+    DetectionErrorResult,
+    DetectionRequest,
+    DetectionResponse,
+    DetectionResult,
+    DetectionSuccessResult,
+    ImageResult,
+)
+from spotter_tpu.taxonomy import AMENITIES_MAPPING
+
+
+class AmenitiesDetector:
+    """Framework-agnostic core; Ray Serve / aiohttp adapters wrap this."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        batcher: MicroBatcher | None = None,
+        client: httpx.AsyncClient | None = None,
+    ) -> None:
+        self.engine = engine
+        self.batcher = batcher or MicroBatcher(engine)
+        self.client = client or httpx.AsyncClient()
+
+    async def _fetch_image_bytes(self, url: str) -> bytes:
+        response = await self.client.get(url)
+        response.raise_for_status()
+        return response.content
+
+    async def _process_single_image(self, url: str) -> ImageResult:
+        try:
+            image_bytes = None
+            retries = AsyncRetrying(
+                stop=stop_after_attempt(3),
+                wait=wait_exponential(multiplier=1, min=4, max=10),
+                reraise=True,
+            )
+            async for attempt in retries:
+                with attempt:
+                    image_bytes = await self._fetch_image_bytes(url)
+            if image_bytes is None:
+                raise Exception("Failed to fetch image after retries")
+
+            with Image.open(BytesIO(image_bytes)) as img_raw:
+                image = img_raw.convert("RGB")
+
+            raw_detections = await self.batcher.submit(image)
+
+            draw = ImageDraw.Draw(image)
+            image_detections: list[DetectionResult] = []
+            for det in raw_detections:
+                amenity = AMENITIES_MAPPING.get(det["label"])
+                if amenity is None:
+                    continue
+                box = det["box"]
+                draw.rectangle(box, outline="red", width=3)
+                draw.text(
+                    xy=(box[0] + 5, box[1] + 5),
+                    text=amenity,
+                    fill="white",
+                    stroke_width=1,
+                    stroke_fill="black",
+                )
+                image_detections.append(DetectionResult(label=amenity, box=box))
+
+            buffer = BytesIO()
+            image.save(buffer, format="JPEG")
+            image_b64 = base64.b64encode(buffer.getvalue()).decode("utf-8")
+
+            return DetectionSuccessResult(
+                url=url, detections=image_detections, labeled_image_base64=image_b64
+            )
+        except httpx.HTTPError as e:
+            return DetectionErrorResult(url=url, error=f"HTTP Error: {e}")
+        except Exception as e:
+            tb_str = traceback.format_exc()
+            return DetectionErrorResult(url=url, error=f"Processing Error: {e}\n{tb_str}")
+
+    async def detect(self, payload: dict) -> DetectionResponse:
+        request = DetectionRequest.model_validate(payload)
+        tasks = [self._process_single_image(str(u)) for u in request.image_urls]
+        results = await asyncio.gather(*tasks)
+
+        amenities: set[str] = set()
+        for result in results:
+            if isinstance(result, DetectionSuccessResult):
+                amenities.update(d.label for d in result.detections)
+
+        description = (
+            f"The property contains: {', '.join(sorted(amenities))}."
+            if amenities
+            else "No relevant amenities detected."
+        )
+        return DetectionResponse(amenities_description=description, images=list(results))
+
+    async def aclose(self) -> None:
+        await self.batcher.stop()
+        await self.client.aclose()

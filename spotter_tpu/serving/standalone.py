@@ -1,0 +1,71 @@
+"""Standalone aiohttp serving runtime (no Ray required).
+
+Serves the same route the Ray Serve app exposes behind the manager proxy
+(route_prefix /detect — rayservice-template.yaml:10; proxy target
+handlers.go:298-304), plus /healthz and /metrics (SURVEY.md §5.5 requires
+throughput/latency counters that the reference lacks).
+"""
+
+import argparse
+import json
+import logging
+
+import pydantic
+from aiohttp import web
+
+from spotter_tpu.serving.app import build_detector_app
+
+logger = logging.getLogger(__name__)
+
+
+def make_app(detector=None, model_name: str | None = None, warmup: bool = False) -> web.Application:
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["detector"] = detector or build_detector_app(model_name, warmup=warmup)
+
+    async def detect(request: web.Request) -> web.Response:
+        try:
+            payload = await request.json()
+        except json.JSONDecodeError:
+            return web.Response(status=400, text="Invalid JSON body")
+        try:
+            response = await request.app["detector"].detect(payload)
+        except pydantic.ValidationError as exc:
+            return web.Response(status=400, text=f"Invalid request: {exc}")
+        except Exception:
+            logger.exception("detect failed")
+            return web.Response(status=500, text="Internal server error")
+        return web.json_response(response.model_dump())
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.json_response(request.app["detector"].engine.metrics.snapshot())
+
+    async def on_cleanup(app: web.Application) -> None:
+        await app["detector"].aclose()
+
+    app.router.add_post("/detect", detect)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="spotter-tpu standalone detection server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--model", default=None, help="overrides MODEL_NAME env")
+    parser.add_argument("--no-warmup", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    web.run_app(
+        make_app(model_name=args.model, warmup=not args.no_warmup),
+        host=args.host,
+        port=args.port,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,178 @@
+"""Detector behavior tests — the analog of the reference's test_serve.py suite:
+plain class, fake model (here: fake engine), no serving runtime required
+(test_serve.py:32 tests the undecorated class the same way)."""
+
+import asyncio
+import base64
+from io import BytesIO
+from unittest.mock import AsyncMock
+
+import httpx
+import numpy as np
+import pytest
+from PIL import Image
+
+from spotter_tpu.engine.batcher import MicroBatcher
+from spotter_tpu.engine.metrics import Metrics
+from spotter_tpu.schemas import DetectionErrorResult, DetectionSuccessResult
+from spotter_tpu.serving.detector import AmenitiesDetector
+
+
+class FakeEngine:
+    """Stands in for InferenceEngine: canned per-image detections."""
+
+    def __init__(self, detections):
+        self.detections = detections
+        self.metrics = Metrics()
+        self.batch_buckets = (1, 2, 4)
+        self.calls = []
+
+    def detect(self, images):
+        self.calls.append(len(images))
+        return [list(self.detections) for _ in images]
+
+
+def _image_bytes(w=64, h=48):
+    img = Image.fromarray(np.full((h, w, 3), 200, np.uint8))
+    buf = BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _detector(detections, fetch=None):
+    engine = FakeEngine(detections)
+    client = AsyncMock(spec=httpx.AsyncClient)
+    if fetch is not None:
+        client.get.side_effect = fetch
+    else:
+        resp = AsyncMock()
+        resp.content = _image_bytes()
+        resp.raise_for_status = lambda: None
+        client.get.return_value = resp
+    return AmenitiesDetector(engine, MicroBatcher(engine, max_delay_ms=1.0), client), engine
+
+
+def test_success_remaps_labels_and_draws():
+    dets = [
+        {"label": "tv", "score": 0.9, "box": [1.0, 2.0, 20.0, 30.0]},
+        {"label": "couch", "score": 0.8, "box": [5.0, 5.0, 40.0, 40.0]},
+        {"label": "remote", "score": 0.9, "box": [0.0, 0.0, 3.0, 3.0]},  # irrelevant
+    ]
+    detector, engine = _detector(dets)
+
+    async def run():
+        return await detector.detect({"image_urls": ["http://example.com/a.jpg"]})
+
+    resp = asyncio.run(run())
+    assert resp.amenities_description == "The property contains: TV, sofa."
+    (img_result,) = resp.images
+    assert isinstance(img_result, DetectionSuccessResult)
+    labels = [d.label for d in img_result.detections]
+    assert labels == ["TV", "sofa"]  # remapped per AMENITIES_MAPPING; remote dropped
+    assert img_result.detections[0].box == [1.0, 2.0, 20.0, 30.0]
+    # labeled image is a decodable JPEG
+    decoded = base64.b64decode(img_result.labeled_image_base64)
+    Image.open(BytesIO(decoded)).verify()
+
+
+def test_irrelevant_only_still_encodes_image():
+    detector, _ = _detector([{"label": "remote", "score": 0.9, "box": [0, 0, 3, 3]}])
+
+    async def run():
+        return await detector.detect({"image_urls": ["http://example.com/a.jpg"]})
+
+    resp = asyncio.run(run())
+    assert resp.amenities_description == "No relevant amenities detected."
+    (img_result,) = resp.images
+    assert img_result.detections == []
+    assert len(img_result.labeled_image_base64) > 0
+
+
+def test_fetch_http_error_contained():
+    def fail(url):
+        raise httpx.ConnectError("boom")
+
+    detector, _ = _detector([], fetch=fail)
+
+    async def run():
+        return await detector.detect(
+            {"image_urls": ["http://bad.example.com/a.jpg", "http://bad.example.com/b.jpg"]}
+        )
+
+    resp = asyncio.run(run())
+    assert all(isinstance(r, DetectionErrorResult) for r in resp.images)
+    assert all(r.error.startswith("HTTP Error:") for r in resp.images)
+    assert resp.amenities_description == "No relevant amenities detected."
+
+
+def test_processing_error_contained_with_traceback():
+    resp_ok = AsyncMock()
+    resp_ok.content = b"not an image"
+    resp_ok.raise_for_status = lambda: None
+
+    detector, _ = _detector([], fetch=lambda url: resp_ok)
+
+    async def run():
+        return await detector.detect({"image_urls": ["http://example.com/a.jpg"]})
+
+    resp = asyncio.run(run())
+    (result,) = resp.images
+    assert isinstance(result, DetectionErrorResult)
+    assert result.error.startswith("Processing Error:")
+    assert "Traceback" in result.error
+
+
+def test_one_bad_url_does_not_fail_batch():
+    calls = {"n": 0}
+
+    def mixed(url):
+        calls["n"] += 1
+        if "bad" in url:
+            raise httpx.ConnectError("down")
+        resp = AsyncMock()
+        resp.content = _image_bytes()
+        resp.raise_for_status = lambda: None
+        return resp
+
+    detector, _ = _detector(
+        [{"label": "oven", "score": 0.9, "box": [1, 1, 9, 9]}], fetch=mixed
+    )
+
+    async def run():
+        return await detector.detect(
+            {"image_urls": ["http://ok.example.com/a.jpg", "http://bad.example.com/b.jpg"]}
+        )
+
+    resp = asyncio.run(run())
+    ok, bad = resp.images
+    assert isinstance(ok, DetectionSuccessResult)
+    assert isinstance(bad, DetectionErrorResult)
+    assert resp.amenities_description == "The property contains: oven."
+    # retry policy: bad URL fetched 3 times (serve.py:84-88), good once
+    assert calls["n"] == 4
+
+
+def test_microbatcher_batches_concurrent_requests():
+    engine = FakeEngine([{"label": "tv", "score": 0.9, "box": [0, 0, 5, 5]}])
+    batcher = MicroBatcher(engine, max_batch=4, max_delay_ms=50.0)
+    img = Image.fromarray(np.zeros((8, 8, 3), np.uint8))
+
+    async def run():
+        results = await asyncio.gather(*[batcher.submit(img) for _ in range(4)])
+        await batcher.stop()
+        return results
+
+    results = asyncio.run(run())
+    assert len(results) == 4
+    # all four went through one device call
+    assert engine.calls == [4] or sum(engine.calls) == 4
+
+
+def test_validation_error_rejects_bad_payload():
+    detector, _ = _detector([])
+
+    async def run():
+        with pytest.raises(Exception):
+            await detector.detect({"image_urls": ["not a url"]})
+
+    asyncio.run(run())

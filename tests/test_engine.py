@@ -1,0 +1,68 @@
+"""Engine tests on the tiny RT-DETR (real jit path, CPU, no torch)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+os.environ["SPOTTER_TPU_TINY"] = "1"
+
+from spotter_tpu.engine.engine import InferenceEngine
+from spotter_tpu.models import build_detector
+
+
+@pytest.fixture(scope="module")
+def engine():
+    built = build_detector("PekingU/rtdetr_v2_r101vd")
+    return InferenceEngine(built, threshold=0.0, batch_buckets=(1, 2, 4))
+
+
+def _imgs(n, hw=(48, 64)):
+    rng = np.random.default_rng(0)
+    return [
+        Image.fromarray(rng.integers(0, 255, size=(*hw, 3), dtype=np.uint8))
+        for _ in range(n)
+    ]
+
+
+def test_detect_shapes_and_fields(engine):
+    results = engine.detect(_imgs(2))
+    assert len(results) == 2
+    for dets in results:
+        assert len(dets) > 0  # threshold 0 -> top-k all returned
+        det = dets[0]
+        assert set(det.keys()) == {"label", "score", "box"}
+        assert len(det["box"]) == 4
+        # boxes are scaled to original-image pixel coords (48x64 image):
+        # cxcywh in (0,1) -> xyxy in (-w/2, 1.5w)
+        xs = [d["box"][0] for d in dets] + [d["box"][2] for d in dets]
+        assert -32.0 <= min(xs) and max(xs) <= 96.0
+
+
+def test_batch_padding_strips_pad_results(engine):
+    # 3 images -> bucket 4; must return exactly 3 results
+    results = engine.detect(_imgs(3))
+    assert len(results) == 3
+
+
+def test_oversize_batch_splits(engine):
+    results = engine.detect(_imgs(6))  # max bucket 4 -> two chunks
+    assert len(results) == 6
+    snap = engine.metrics.snapshot()
+    assert snap["images_total"] >= 6
+    assert snap["batches_total"] >= 2
+
+
+def test_tiny_registry_model_name_matching():
+    built = build_detector("PekingU/rtdetr_v2_r18vd")
+    assert built.postprocess == "sigmoid_topk"
+    assert built.id2label[62] == "tv"
+
+
+def test_threshold_filters(engine):
+    # with a high threshold the random model should return nothing
+    high = InferenceEngine(engine.built, threshold=0.99, batch_buckets=(1,))
+    results = high.detect(_imgs(1))
+    assert results == [[]]

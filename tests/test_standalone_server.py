@@ -1,0 +1,65 @@
+"""HTTP surface test for the standalone server: /detect wire contract,
+/healthz, /metrics — driven through aiohttp's test client."""
+
+import asyncio
+import os
+from io import BytesIO
+from unittest.mock import AsyncMock
+
+import httpx
+import numpy as np
+from aiohttp.test_utils import TestClient, TestServer
+from PIL import Image
+
+os.environ["SPOTTER_TPU_TINY"] = "1"
+
+from spotter_tpu.engine.batcher import MicroBatcher
+from spotter_tpu.engine.engine import InferenceEngine
+from spotter_tpu.models import build_detector
+from spotter_tpu.serving.detector import AmenitiesDetector
+from spotter_tpu.serving.standalone import make_app
+
+
+def _client_returning_image():
+    img = Image.fromarray(np.full((32, 32, 3), 128, np.uint8))
+    buf = BytesIO()
+    img.save(buf, format="JPEG")
+    resp = AsyncMock()
+    resp.content = buf.getvalue()
+    resp.raise_for_status = lambda: None
+    client = AsyncMock(spec=httpx.AsyncClient)
+    client.get.return_value = resp
+    return client
+
+
+def test_detect_healthz_metrics_round_trip():
+    async def run():
+        built = build_detector("PekingU/rtdetr_v2_r18vd")
+        engine = InferenceEngine(built, threshold=0.0, batch_buckets=(1, 2))
+        detector = AmenitiesDetector(
+            engine, MicroBatcher(engine, max_delay_ms=1.0), _client_returning_image()
+        )
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            health = await client.get("/healthz")
+            assert health.status == 200
+            assert (await health.json()) == {"status": "ok"}
+
+            resp = await client.post(
+                "/detect", json={"image_urls": ["http://example.com/room.jpg"]}
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert set(body.keys()) == {"amenities_description", "images"}
+            (img_result,) = body["images"]
+            assert set(img_result.keys()) == {"url", "detections", "labeled_image_base64"}
+
+            bad = await client.post("/detect", data=b"{not json")
+            assert bad.status == 400
+
+            metrics = await client.get("/metrics")
+            snap = await metrics.json()
+            assert snap["images_total"] >= 1
+            assert snap["latency_ms_p50"] > 0
+
+    asyncio.run(run())
