@@ -18,6 +18,9 @@ logger = logging.getLogger(__name__)
 
 CACHE_ENV = "SPOTTER_TPU_CACHE"
 DEFAULT_CACHE = "~/.cache/spotter_tpu"
+# Bump when conversion rules change: the cache key must invalidate old
+# conversions, or a fixed rule table would keep serving stale params forever.
+CACHE_VERSION = "v2"
 
 
 def cache_dir() -> Path:
@@ -25,7 +28,7 @@ def cache_dir() -> Path:
 
 
 def _cache_path(model_name: str) -> Path:
-    return cache_dir() / model_name.replace("/", "--")
+    return cache_dir() / f"{model_name.replace('/', '--')}--{CACHE_VERSION}"
 
 
 def _save_cache(path: Path, params: dict) -> None:
@@ -72,6 +75,9 @@ def load_rtdetr_from_hf(model_name: str) -> tuple[RTDetrConfig, dict]:
 
     with torch.no_grad():
         model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
-    params = convert_state_dict(model.state_dict(), rtdetr_rules(cfg), strict=False)
+    # strict: a rule whose torch key is absent means the rule table and the
+    # checkpoint disagree — caching such a partial tree would serve a broken
+    # model silently on every later pod start.
+    params = convert_state_dict(model.state_dict(), rtdetr_rules(cfg), strict=True)
     _save_cache(_cache_path(model_name), params)
     return cfg, params

@@ -42,6 +42,11 @@ class MicroBatcher:
             except asyncio.CancelledError:
                 pass
             self._pump_task = None
+        # fail anything still queued so no submit() caller waits forever
+        while not self._queue.empty():
+            _, fut = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(RuntimeError("MicroBatcher stopped"))
 
     async def submit(self, image: Image.Image) -> list[dict]:
         """One image in, its detections out (awaits the batched device call)."""

@@ -73,13 +73,32 @@ class InferenceEngine:
         device: Optional[jax.Device] = None,
         metrics: Optional[Metrics] = None,
         donate_pixels: bool = True,
+        mesh=None,
+        tp_rules: Sequence = (),
     ) -> None:
+        """`mesh`: optional ("dp","tp") Mesh — batch axis sharded over "dp",
+        params replicated (or TP-split per `tp_rules`); XLA inserts the
+        collectives. Without a mesh, single-device placement as before."""
         self.built = built
         self.threshold = threshold
-        self.batch_buckets = tuple(sorted(batch_buckets))
-        self.device = device or jax.devices()[0]
         self.metrics = metrics or Metrics()
-        self.params = jax.device_put(built.params, self.device)
+        self.mesh = mesh
+        if mesh is not None:
+            from spotter_tpu.parallel.sharding import data_sharding, shard_params
+
+            dp = mesh.shape["dp"]
+            # every bucket must split evenly across dp shards: round UP so the
+            # configured max batch capacity is kept, never shrunk
+            batch_buckets = sorted({-(-b // dp) * dp for b in batch_buckets})
+            self.batch_buckets = tuple(batch_buckets)
+            self.device = None
+            self.params = shard_params(built.params, mesh, tp_rules)
+            self._in_sharding = data_sharding(mesh)
+        else:
+            self.batch_buckets = tuple(sorted(batch_buckets))
+            self.device = device or jax.devices()[0]
+            self.params = jax.device_put(built.params, self.device)
+            self._in_sharding = self.device
         post_fn = POSTPROCESS_KINDS[built.postprocess]
         k = built.num_top_queries
 
@@ -92,8 +111,12 @@ class InferenceEngine:
                 )
             return post_fn(out["logits"], out["pred_boxes"], target_sizes)
 
-        # One compiled program per batch bucket; jit caches by shape.
-        self._forward = jax.jit(forward)
+        # One compiled program per batch bucket; jit caches by shape. Pixel
+        # buffers are donated: they are per-call staging arrays and freeing
+        # them keeps HBM headroom at large buckets.
+        self._forward = jax.jit(
+            forward, donate_argnums=(1,) if donate_pixels else ()
+        )
 
     def bucket_for(self, n: int) -> int:
         for b in self.batch_buckets:
@@ -105,8 +128,10 @@ class InferenceEngine:
         """Compile every bucket ahead of traffic (first compile is slow)."""
         h, w = self.built.preprocess_spec.input_hw
         for b in self.batch_buckets:
-            pixels = jnp.zeros((b, h, w, 3), jnp.float32)
-            sizes = jnp.ones((b, 2), jnp.float32)
+            # device_put with the serving sharding so warmup compiles the
+            # exact programs the traffic path will hit (no recompiles later)
+            pixels = jax.device_put(np.zeros((b, h, w, 3), np.float32), self._in_sharding)
+            sizes = jax.device_put(np.ones((b, 2), np.float32), self._in_sharding)
             jax.block_until_ready(self._forward(self.params, pixels, sizes))
 
     def detect(self, images: list[Image.Image]) -> list[list[dict]]:
@@ -133,7 +158,9 @@ class InferenceEngine:
             pixels = np.concatenate([pixels, np.zeros((pad, *pixels.shape[1:]), pixels.dtype)])
             sizes = np.concatenate([sizes, np.ones((pad, 2), sizes.dtype)])
         scores, labels, boxes = self._forward(
-            self.params, jnp.asarray(pixels), jnp.asarray(sizes)
+            self.params,
+            jax.device_put(pixels, self._in_sharding),
+            jax.device_put(sizes, self._in_sharding),
         )
         scores, labels, boxes = jax.device_get((scores, labels, boxes))
         out = [

@@ -19,16 +19,18 @@ class Metrics:
         self._batches_total = 0
         self._batch_sizes: deque[int] = deque(maxlen=window)
         self._started = time.monotonic()
-        self._window_start = time.monotonic()
-        self._window_images = 0
+        # (timestamp, batch_size) ring for rate computation — snapshot() reads
+        # it without mutating shared state, so concurrent scrapers don't
+        # corrupt each other's view
+        self._arrivals: deque[tuple[float, int]] = deque(maxlen=window)
 
     def record_batch(self, batch_size: int, latency_s: float) -> None:
         with self._lock:
             self._images_total += batch_size
-            self._window_images += batch_size
             self._batches_total += 1
             self._batch_sizes.append(batch_size)
             self._latencies_ms.append(latency_s * 1000.0)
+            self._arrivals.append((time.monotonic(), batch_size))
 
     def record_error(self, n: int = 1) -> None:
         with self._lock:
@@ -38,12 +40,13 @@ class Metrics:
         with self._lock:
             lats = sorted(self._latencies_ms)
             now = time.monotonic()
-            window_s = max(now - self._window_start, 1e-9)
-            images_per_sec = self._window_images / window_s
-            # roll the throughput window so the rate tracks recent load
-            if window_s > 30.0:
-                self._window_start = now
-                self._window_images = 0
+            # rate over the last 30 s of arrivals (read-only)
+            recent = [(t, n) for t, n in self._arrivals if now - t <= 30.0]
+            if recent:
+                span = max(now - recent[0][0], 1e-9)
+                images_per_sec = sum(n for _, n in recent) / span
+            else:
+                images_per_sec = 0.0
 
             def pct(p: float) -> float:
                 if not lats:

@@ -1,0 +1,34 @@
+"""Parallelism: device meshes, sharding rules, multi-host bring-up.
+
+The reference scales by Ray Serve replicas over CPU pods
+(configs/rayservice-template.yaml:43-45); here the chip-level fabric is a
+`jax.sharding.Mesh` with XLA collectives over ICI (SURVEY.md §2.4) and the
+process-level fabric stays Ray/k8s. This package owns mesh construction
+("dp" x "tp" axes), parameter/batch sharding rules, and the
+`jax.distributed` multi-host bootstrap driven by TPU_WORKER_* env the way
+the reference's pods are driven by MODEL_NAME env (serve.py:199-205).
+"""
+
+from spotter_tpu.parallel.mesh import local_mesh, make_mesh
+from spotter_tpu.parallel.multihost import initialize_multihost, multihost_env_summary
+from spotter_tpu.parallel.sharding import (
+    RTDETR_TP_RULES,
+    data_sharding,
+    param_shardings,
+    replicated,
+    shard_params,
+    spec_for_path,
+)
+
+__all__ = [
+    "local_mesh",
+    "make_mesh",
+    "initialize_multihost",
+    "multihost_env_summary",
+    "RTDETR_TP_RULES",
+    "data_sharding",
+    "param_shardings",
+    "replicated",
+    "shard_params",
+    "spec_for_path",
+]

@@ -1,0 +1,45 @@
+"""Mesh construction: the chip-level topology every sharded program runs over.
+
+Axes convention (fixed across the framework):
+- "dp"  — data parallel: batch dimension sharded, params replicated;
+- "tp"  — tensor parallel: FFN / attention projections sharded (used only
+  when a model outgrows one chip — SURVEY.md §2.3 row TP).
+
+PP/SP/EP axes are deliberately absent: the model families served here are
+single-chip vision detectors with no sequence axis and no MoE (SURVEY.md
+§2.3, §5.7); the mesh API keeps room for more axes without breaking callers.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    dp: Optional[int] = None,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ("dp", "tp") mesh over `devices` (default: all of them).
+
+    `dp` defaults to n_devices // tp, so `make_mesh()` is the whole machine
+    data-parallel and `make_mesh(tp=4)` splits each DP group 4-way.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if tp <= 0:
+        raise ValueError(f"tp must be positive, got {tp}")
+    if dp is None:
+        if len(devs) % tp:
+            raise ValueError(f"{len(devs)} devices not divisible by tp={tp}")
+        dp = len(devs) // tp
+    if dp * tp > len(devs):
+        raise ValueError(f"dp*tp = {dp * tp} exceeds {len(devs)} devices")
+    grid = np.asarray(devs[: dp * tp]).reshape(dp, tp)
+    return Mesh(grid, ("dp", "tp"))
+
+
+def local_mesh() -> Mesh:
+    """Single-process mesh over all local devices, pure data parallel."""
+    return make_mesh(dp=len(jax.local_devices()), tp=1, devices=jax.local_devices())

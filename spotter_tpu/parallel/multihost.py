@@ -1,0 +1,66 @@
+"""Multi-host bring-up: TPU_WORKER_* env -> jax.distributed.
+
+The reference wires pods together with env + cluster DNS (MODEL_NAME into the
+serve pod — serve.py:199; head-svc DNS into the proxy — handlers.go:298-304).
+The multi-host TPU workerGroup does the same: the RayService template
+(configs/rayservice-tpu-template.yaml) injects TPU_WORKER_ID and
+TPU_WORKER_HOSTNAMES, and this module turns them into a
+`jax.distributed.initialize` call so all hosts join one XLA runtime; cross-
+host collectives then ride DCN while intra-slice traffic stays on ICI
+(SURVEY.md §2.4).
+"""
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_COORD_PORT_DEFAULT = 8476
+
+
+def multihost_env_summary() -> dict:
+    """The env contract the k8s template must satisfy (also used by tests)."""
+    return {
+        "TPU_WORKER_ID": os.environ.get("TPU_WORKER_ID"),
+        "TPU_WORKER_HOSTNAMES": os.environ.get("TPU_WORKER_HOSTNAMES"),
+        "SPOTTER_COORDINATOR_PORT": os.environ.get(
+            "SPOTTER_COORDINATOR_PORT", str(_COORD_PORT_DEFAULT)
+        ),
+    }
+
+
+def initialize_multihost(force: bool = False) -> bool:
+    """Join the jax.distributed cluster if the TPU_WORKER_* env says we're in one.
+
+    Returns True when distributed init ran (or had already run), False for the
+    single-host case. Safe to call unconditionally at serving bootstrap — the
+    single-host path is a no-op, mirroring how the reference's serve.py runs
+    identically in 1-pod and autoscaled deployments.
+    """
+    env = multihost_env_summary()
+    hostnames = env["TPU_WORKER_HOSTNAMES"]
+    worker_id = env["TPU_WORKER_ID"]
+    if not hostnames or worker_id is None:
+        if force:
+            raise RuntimeError(
+                "initialize_multihost(force=True) but TPU_WORKER_HOSTNAMES / "
+                "TPU_WORKER_ID are not set"
+            )
+        return False
+
+    hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
+    coordinator = f"{hosts[0]}:{env['SPOTTER_COORDINATOR_PORT']}"
+    if jax.distributed.is_initialized():  # already up
+        return True
+    logger.info(
+        "multihost init: coordinator=%s num_processes=%d process_id=%s",
+        coordinator, len(hosts), worker_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=len(hosts),
+        process_id=int(worker_id),
+    )
+    return True

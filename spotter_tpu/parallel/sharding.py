@@ -1,0 +1,79 @@
+"""Sharding rules: param-tree path regexes -> PartitionSpec.
+
+The scaling-book recipe: pick a mesh, annotate params and batch with
+NamedShardings, and let XLA's SPMD partitioner insert the collectives.
+Nothing here calls a collective explicitly — jit + these shardings is the
+entire distributed backend (SURVEY.md §2.4).
+
+Rules are (regex, PartitionSpec) pairs matched against "/"-joined param
+paths (e.g. "decoder_layer0/fc1/kernel"); first match wins, no match means
+fully replicated. Megatron-style TP: up-projections (fc1, q/k/v) split the
+output feature axis, down-projections (fc2, out_proj) split the input axis,
+so each FFN/attention block needs one psum, placed by XLA.
+"""
+
+import re
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[tuple[str, P]]
+
+# RT-DETR family (models/rtdetr.py param tree). Deformable-attention projections
+# stay replicated: their head axis is folded with levels*points and per-query
+# gathers dominate, so TP there buys little and costs reshard traffic.
+RTDETR_TP_RULES: Rules = (
+    (r".*/(fc1|q_proj|k_proj|v_proj)/kernel$", P(None, "tp")),
+    (r".*/(fc1|q_proj|k_proj|v_proj)/bias$", P("tp")),
+    (r".*/(fc2|out_proj)/kernel$", P("tp", None)),
+)
+
+
+def spec_for_path(path: str, rules: Rules) -> P:
+    for pattern, spec in rules:
+        if re.match(pattern, path):
+            return spec
+    return P()
+
+
+def _tree_paths_and_specs(params, rules: Rules, mesh: Mesh):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for key_path, leaf in flat:
+        path = "/".join(
+            getattr(k, "key", getattr(k, "idx", str(k))).__str__() for k in key_path
+        )
+        spec = spec_for_path(path, rules)
+        # A rule that names an axis the leaf can't be split on (ndim or
+        # divisibility) would crash device_put deep inside XLA; fall back to
+        # replicated instead — correct, just less sharded.
+        if len(spec) > leaf.ndim or any(
+            axis is not None and leaf.shape[dim] % mesh.shape[axis]
+            for dim, axis in enumerate(spec)
+        ):
+            spec = P()
+        specs.append(spec)
+    return treedef, [leaf for _, leaf in flat], specs
+
+
+def param_shardings(params, mesh: Mesh, rules: Rules = ()):
+    """Pytree of NamedSharding matching `params` (default: replicated)."""
+    treedef, _, specs = _tree_paths_and_specs(params, rules, mesh)
+    return jax.tree_util.tree_unflatten(
+        treedef, [NamedSharding(mesh, s) for s in specs]
+    )
+
+
+def shard_params(params, mesh: Mesh, rules: Rules = ()):
+    """device_put the whole param tree onto the mesh per `rules`."""
+    return jax.device_put(params, param_shardings(params, mesh, rules))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch tensors: leading axis split across "dp", rest replicated."""
+    return NamedSharding(mesh, P("dp"))

@@ -59,7 +59,10 @@ def ray_deployment():
 
 try:  # module-level `deployment` preserved for manifest import_path parity
     import ray  # noqa: F401
-
-    deployment = ray_deployment()
-except Exception:  # Ray not installed / not initialized — standalone mode
+except ImportError:  # Ray not installed — standalone mode
     deployment = None
+else:
+    # With Ray present, real bootstrap errors (missing MODEL_NAME, model load
+    # failure) must propagate like the reference's import-time raise
+    # (serve.py:199-201), not turn into an opaque import_path=None deploy.
+    deployment = ray_deployment()

@@ -1,0 +1,27 @@
+"""Training: Hungarian-matched detection loss + sharded train step.
+
+The reference is inference-only (SURVEY.md intro: no trainer), but a complete
+framework must let users fine-tune the served detectors on their own amenity
+data. Everything here is jit-first: the matcher is `optax.assignment`'s
+Hungarian algorithm (exact, jittable, vmapped over the batch), targets are
+fixed-shape padded tensors, and the train step runs under the same
+("dp", "tp") mesh the serving engine uses (spotter_tpu.parallel).
+"""
+
+from spotter_tpu.train.losses import Targets, detection_loss, hungarian_match
+from spotter_tpu.train.train_step import (
+    TrainBatch,
+    TrainState,
+    create_train_state,
+    make_train_step,
+)
+
+__all__ = [
+    "Targets",
+    "TrainBatch",
+    "detection_loss",
+    "hungarian_match",
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+]

@@ -1,0 +1,72 @@
+"""Sharded train step: one jit region = forward + loss + grads + optimizer.
+
+The step is mesh-agnostic: params arrive already placed by
+`spotter_tpu.parallel.shard_params` (replicated or TP-split) and the batch
+arrives "dp"-sharded; XLA's SPMD partitioner inserts the gradient psums —
+there is no explicit collective anywhere (SURVEY.md §2.4). Donating the
+state keeps HBM flat across steps.
+"""
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from spotter_tpu.train.losses import Targets, detection_loss
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: optax.OptState
+
+
+class TrainBatch(NamedTuple):
+    pixels: jnp.ndarray  # (B, H, W, 3) float32
+    targets: Targets
+
+
+def create_train_state(
+    params,
+    optimizer: optax.GradientTransformation,
+) -> TrainState:
+    """Build a state whose opt-state mirrors the params' placement.
+
+    optax init runs eagerly on the (possibly sharded) params; zeros_like et al
+    preserve shardings, so mu/nu land on the same mesh layout as the params.
+    """
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=optimizer.init(params),
+    )
+
+
+def make_train_step(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    loss_fn: Callable = detection_loss,
+    donate: bool = True,
+) -> Callable:
+    """Returns jitted `step(state, batch) -> (state, metrics)`.
+
+    `apply_fn(params, pixels) -> outputs dict` (e.g. a closure over
+    RTDetrDetector.apply). Gradient clipping / schedules belong inside
+    `optimizer` (optax chain) so the step stays one fused XLA program.
+    """
+
+    def compute_loss(params, batch: TrainBatch):
+        outputs = apply_fn(params, batch.pixels)
+        return loss_fn(outputs, batch.targets)
+
+    def step(state: TrainState, batch: TrainBatch):
+        (loss, logged), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+            state.params, batch
+        )
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"grad_norm": optax.global_norm(grads), **logged}
+        return TrainState(state.step + 1, params, opt_state), metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,15 @@
+"""The driver contract file must work on the virtual 8-device CPU mesh."""
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import __graft_entry__ as graft
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_1():
+    graft.dryrun_multichip(1)
