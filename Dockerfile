@@ -1,0 +1,24 @@
+# Serving image for the spotter-tpu Ray Serve app on TPU node pools.
+#
+# Reference analog: apps/spotter/Dockerfile (ray base image, pip install,
+# weight baking via the download script). TPU differences: jax[tpu] instead
+# of cpu torch, and the baked artifact is the converted Flax param cache
+# (torch is only present at build time for the conversion step).
+FROM rayproject/ray:2.44.1-py312-cpu
+
+ARG MODEL_NAME=PekingU/rtdetr_v2_r101vd
+ENV MODEL_NAME=${MODEL_NAME}
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY spotter_tpu ./spotter_tpu
+
+# Cache path must be pinned BEFORE the bake step so build-time conversion and
+# runtime load agree on it (the ray base image runs as user `ray`).
+ENV SPOTTER_TPU_CACHE=/home/ray/.cache/spotter_tpu
+
+RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir .[torch] \
+    && spotter-tpu-download \
+    && pip uninstall -y torch transformers timm accelerate
+EXPOSE 8000

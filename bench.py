@@ -1,0 +1,96 @@
+"""Benchmark: RT-DETRv2-R101 device throughput on one chip (BASELINE.md north star).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The north star is >=2000 images/sec on a v5e-4; per-chip that is 500 img/s,
+so vs_baseline = (measured img/s on this chip) / 500. Weights are random-init
+(zero-egress image: no HF downloads) — throughput is weight-independent; the
+numerical-parity story lives in tests/test_rtdetr_parity.py instead.
+
+Flags: --model (preset key), --batches (candidate sizes), --iters, --json-only.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="rtdetr_v2_r101vd")
+    parser.add_argument("--batches", default="8,16,32")
+    parser.add_argument("--iters", type=int, default=30)
+    parser.add_argument("--baseline-per-chip", type=float, default=500.0)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from spotter_tpu.models.configs import RTDETR_PRESETS
+    from spotter_tpu.models.rtdetr import RTDetrDetector
+    from spotter_tpu.ops.postprocess import sigmoid_topk_postprocess
+
+    dev = jax.devices()[0]
+    cfg = RTDETR_PRESETS[args.model]
+    module = RTDetrDetector(cfg)
+    h = w = 640
+
+    params = module.init(jax.random.PRNGKey(0), np.zeros((1, h, w, 3), np.float32))[
+        "params"
+    ]
+    params = jax.device_put(params, dev)
+
+    @jax.jit
+    def forward(params, pixels, sizes):
+        out = module.apply({"params": params}, pixels)
+        return sigmoid_topk_postprocess(
+            out["logits"], out["pred_boxes"], sizes, k=cfg.num_queries
+        )
+
+    best = {"images_per_sec": 0.0, "batch": 0, "p50_ms": 0.0}
+    for batch in [int(b) for b in args.batches.split(",")]:
+        pixels_np = np.random.default_rng(0).standard_normal((batch, h, w, 3)).astype(
+            np.float32
+        )
+        sizes_np = np.full((batch, 2), 640.0, np.float32)
+        try:
+            # fresh arrays per call (forward donates pixels)
+            put = lambda: (
+                jax.device_put(pixels_np, dev), jax.device_put(sizes_np, dev)
+            )
+            px, sz = put()
+            jax.block_until_ready(forward(params, px, sz))  # compile
+            times = []
+            for _ in range(args.iters):
+                px, sz = put()
+                t0 = time.perf_counter()
+                jax.block_until_ready(forward(params, px, sz))
+                times.append(time.perf_counter() - t0)
+        except Exception as exc:  # e.g. OOM at a large bucket
+            print(f"# batch {batch} failed: {exc}", file=sys.stderr)
+            continue
+        p50 = float(np.median(times))
+        ips = batch / p50
+        print(
+            f"# batch={batch}: p50={p50 * 1e3:.2f} ms, {ips:.0f} img/s",
+            file=sys.stderr,
+        )
+        if ips > best["images_per_sec"]:
+            best = {"images_per_sec": ips, "batch": batch, "p50_ms": p50 * 1e3}
+
+    result = {
+        "metric": f"{args.model} images/sec/chip ({dev.platform}, batch "
+        f"{best['batch']}, 640x640, p50 {best['p50_ms']:.2f} ms)",
+        "value": round(best["images_per_sec"], 1),
+        "unit": "images/sec",
+        "vs_baseline": round(best["images_per_sec"] / args.baseline_per_chip, 3),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+#include "handlers.h"
+
+#include <fstream>
+#include <sstream>
+
+namespace spotter {
+
+namespace {
+
+std::string ReadFile(const std::string& path, bool* ok) {
+  std::ifstream f(path, std::ios::binary);
+  *ok = static_cast<bool>(f);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+HttpResponse TextResponse(int status, const std::string& body) {
+  HttpResponse r;
+  r.status = status;
+  r.body = body;
+  return r;
+}
+
+bool ValidName(const std::string& s) {
+  // query params that land inside a YAML manifest must not inject structure
+  for (char c : s) {
+    if (!(isalnum(static_cast<unsigned char>(c)) || c == '.' || c == '-' ||
+          c == '_' || c == '/' || c == ':'))
+      return false;
+  }
+  return !s.empty();
+}
+
+}  // namespace
+
+bool RenderTemplate(const std::string& tmpl,
+                    const std::map<std::string, std::string>& params,
+                    std::string* out, std::string* error) {
+  out->clear();
+  size_t pos = 0;
+  while (true) {
+    size_t open = tmpl.find("{{", pos);
+    if (open == std::string::npos) {
+      out->append(tmpl, pos, std::string::npos);
+      return true;
+    }
+    out->append(tmpl, pos, open - pos);
+    size_t close = tmpl.find("}}", open);
+    if (close == std::string::npos) {
+      *error = "unterminated {{ in template";
+      return false;
+    }
+    std::string ref = tmpl.substr(open + 2, close - open - 2);
+    // trim spaces, expect ".Key"
+    size_t b = ref.find_first_not_of(' ');
+    size_t e = ref.find_last_not_of(' ');
+    ref = b == std::string::npos ? "" : ref.substr(b, e - b + 1);
+    if (ref.empty() || ref[0] != '.') {
+      *error = "unsupported template ref {{" + ref + "}}";
+      return false;
+    }
+    auto it = params.find(ref.substr(1));
+    if (it == params.end()) {
+      *error = "template references unknown param " + ref;
+      return false;
+    }
+    out->append(it->second);
+    pos = close + 2;
+  }
+}
+
+HttpResponse ServeFrontend(const ManagerOptions& opts, const HttpRequest&) {
+  bool ok = false;
+  std::string html = ReadFile(opts.web_dir + "/index.html", &ok);
+  if (!ok) return TextResponse(500, "Error reading frontend file\n");
+  HttpResponse r;
+  // same no-cache triple as the reference (handlers.go:46-48)
+  r.headers["Cache-Control"] = "no-cache, no-store, must-revalidate";
+  r.headers["Pragma"] = "no-cache";
+  r.headers["Expires"] = "0";
+  r.headers["Content-Type"] = "text/html; charset=utf-8";
+  r.body = html;
+  return r;
+}
+
+HttpResponse HandleDeploy(const ManagerOptions& opts, K8sClient* client,
+                          const HttpRequest& req) {
+  if (req.method != "POST")
+    return TextResponse(405, "Method Not Allowed\n");
+
+  std::string image = req.QueryParam("dockerimage");
+  if (image.empty())
+    return TextResponse(400, "Missing required query parameter: dockerimage\n");
+
+  // TPU extension params with single-chip defaults (BASELINE config #2)
+  std::map<std::string, std::string> params{
+      {"DockerImage", image},
+      {"Accelerator", req.QueryParam("accelerator").empty()
+                          ? "tpu-v5-lite-podslice"
+                          : req.QueryParam("accelerator")},
+      {"Topology", req.QueryParam("topology").empty()
+                       ? "1x1"
+                       : req.QueryParam("topology")},
+      {"ModelName", req.QueryParam("model").empty()
+                        ? "PekingU/rtdetr_v2_r101vd"
+                        : req.QueryParam("model")},
+      {"NumWorkers", req.QueryParam("numworkers").empty()
+                         ? "1"
+                         : req.QueryParam("numworkers")},
+  };
+  for (const auto& [key, value] : params) {
+    if (!ValidName(value))
+      return TextResponse(400, "Invalid characters in parameter " + key + "\n");
+  }
+
+  bool ok = false;
+  std::string tmpl =
+      ReadFile(opts.configs_dir + "/" + opts.template_file, &ok);
+  if (!ok)
+    return TextResponse(500, "Error reading RayService template\n");
+
+  std::string manifest, render_err;
+  if (!RenderTemplate(tmpl, params, &manifest, &render_err))
+    return TextResponse(500, "Error rendering RayService template: " +
+                                 render_err + "\n");
+
+  ClientResult res =
+      client->ApplyRayService(opts.ns, opts.service_name, manifest);
+  if (!res.ok)
+    return TextResponse(500, "Error applying RayService: " + res.error + "\n");
+  if (res.status < 200 || res.status >= 300)
+    return TextResponse(500, "Error applying RayService: apiserver returned " +
+                                 std::to_string(res.status) + ": " + res.body +
+                                 "\n");
+  return TextResponse(
+      200, "Successfully deployed RayService '" + opts.service_name +
+               "' with image '" + image + "'\n");
+}
+
+HttpResponse HandleDelete(const ManagerOptions& opts, K8sClient* client,
+                          const HttpRequest& req) {
+  if (req.method != "POST")
+    return TextResponse(405, "Method Not Allowed\n");
+
+  ClientResult res = client->DeleteRayService(opts.ns, opts.service_name);
+  if (!res.ok)
+    return TextResponse(500, "Error deleting RayService: " + res.error + "\n");
+  if (res.status == 404)  // NotFound is success with a distinct message
+                          // (handlers.go:233-238)
+    return TextResponse(200, "RayService '" + opts.service_name +
+                                 "' did not exist\n");
+  if (res.status < 200 || res.status >= 300)
+    return TextResponse(500, "Error deleting RayService: apiserver returned " +
+                                 std::to_string(res.status) + ": " + res.body +
+                                 "\n");
+  return TextResponse(
+      200, "Successfully deleted RayService '" + opts.service_name + "'\n");
+}
+
+HttpResponse HandleDetectProxy(const ManagerOptions& opts,
+                               const HttpRequest& req) {
+  if (req.method != "POST")
+    return TextResponse(405, "Method Not Allowed\n");
+
+  std::map<std::string, std::string> headers;
+  auto ct = req.headers.find("content-type");
+  headers["Content-Type"] =
+      ct == req.headers.end() ? "application/json" : ct->second;
+
+  ClientResult res =
+      HttpDo("POST", opts.backend_url, headers, req.body, opts.proxy_timeout_s);
+  if (!res.ok)  // 502 + message prefix matching the reference
+                // (handlers.go:341-354)
+    return TextResponse(502,
+                        "Failed to reach backend service: " + res.error + "\n");
+
+  HttpResponse out;
+  out.status = res.status;
+  auto rct = res.headers.find("content-type");
+  out.headers["Content-Type"] =
+      rct == res.headers.end() ? "application/json" : rct->second;
+  out.body = res.body;
+  return out;
+}
+
+void RegisterRoutes(HttpServer* server, const ManagerOptions& opts,
+                    K8sClient* client) {
+  server->Route("GET", "/",
+                [opts](const HttpRequest& r) { return ServeFrontend(opts, r); });
+  server->Route("*", "/deploy", [opts, client](const HttpRequest& r) {
+    return HandleDeploy(opts, client, r);
+  });
+  server->Route("*", "/delete", [opts, client](const HttpRequest& r) {
+    return HandleDelete(opts, client, r);
+  });
+  server->Route("*", "/detect", [opts](const HttpRequest& r) {
+    return HandleDetectProxy(opts, r);
+  });
+}
+
+}  // namespace spotter

@@ -1,0 +1,52 @@
+// Control-plane handlers: frontend, deploy, delete, detect proxy.
+//
+// Same four routes as the reference manager (cmd/spotter-manager/main.go:
+// 24-34), with the /deploy contract extended for TPU serving: in addition to
+// `dockerimage` (handlers.go:61-67) it accepts `accelerator`, `topology`,
+// `model`, and `numworkers` query params, rendered into the TPU workerGroup
+// of the RayService template (the designed extension point — SURVEY.md §5.6).
+
+#pragma once
+
+#include <string>
+
+#include "http.h"
+#include "k8s.h"
+
+namespace spotter {
+
+// {{.Key}} substitution over the manifest template (text/template subset:
+// the reference template only uses pipeline-free field refs —
+// configs/rayservice-template.yaml:23,51). Unknown {{.Key}} refs are an
+// error, listed in *error.
+bool RenderTemplate(const std::string& tmpl,
+                    const std::map<std::string, std::string>& params,
+                    std::string* out, std::string* error);
+
+struct ManagerOptions {
+  std::string web_dir = "web";          // index.html location
+  std::string configs_dir = "configs";  // rayservice template location
+  std::string template_file = "rayservice-tpu-template.yaml";
+  std::string ns = "spotter";
+  std::string service_name = "spotter-ray-service";
+  // /detect upstream; cluster DNS of the Ray head serve port
+  // (handlers.go:298-304)
+  std::string backend_url =
+      "http://spotter-ray-service-head-svc.spotter.svc.cluster.local:8000"
+      "/detect";
+  int proxy_timeout_s = 60;  // handlers.go:309
+};
+
+HttpResponse ServeFrontend(const ManagerOptions& opts, const HttpRequest& req);
+HttpResponse HandleDeploy(const ManagerOptions& opts, K8sClient* client,
+                          const HttpRequest& req);
+HttpResponse HandleDelete(const ManagerOptions& opts, K8sClient* client,
+                          const HttpRequest& req);
+HttpResponse HandleDetectProxy(const ManagerOptions& opts,
+                               const HttpRequest& req);
+
+// wire all four routes onto a server
+void RegisterRoutes(HttpServer* server, const ManagerOptions& opts,
+                    K8sClient* client);
+
+}  // namespace spotter

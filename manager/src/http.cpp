@@ -1,0 +1,377 @@
+#include "http.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <sstream>
+
+#include "tls.h"
+
+namespace spotter {
+
+namespace {
+
+std::string ToLower(std::string s) {
+  for (auto& c : s) c = static_cast<char>(tolower(c));
+  return s;
+}
+
+std::string UrlDecode(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    if (in[i] == '%' && i + 2 < in.size()) {
+      out += static_cast<char>(strtol(in.substr(i + 1, 2).c_str(), nullptr, 16));
+      i += 2;
+    } else if (in[i] == '+') {
+      out += ' ';
+    } else {
+      out += in[i];
+    }
+  }
+  return out;
+}
+
+const char* StatusText(int code) {
+  switch (code) {
+    case 200: return "OK";
+    case 201: return "Created";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 500: return "Internal Server Error";
+    case 502: return "Bad Gateway";
+    default: return "";
+  }
+}
+
+// read until \r\n\r\n then content-length more bytes; 1 MiB header cap
+bool ReadRequest(int fd, std::string* raw, size_t* header_end) {
+  char buf[8192];
+  while (true) {
+    size_t pos = raw->find("\r\n\r\n");
+    if (pos != std::string::npos) {
+      *header_end = pos + 4;
+      return true;
+    }
+    if (raw->size() > (1 << 20)) return false;
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n <= 0) return false;
+    raw->append(buf, static_cast<size_t>(n));
+  }
+}
+
+bool SendAll(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+std::string HttpRequest::QueryParam(const std::string& key) const {
+  size_t pos = 0;
+  while (pos <= query.size()) {
+    size_t amp = query.find('&', pos);
+    std::string pair = query.substr(pos, amp == std::string::npos ? std::string::npos
+                                                                  : amp - pos);
+    size_t eq = pair.find('=');
+    if (eq != std::string::npos && UrlDecode(pair.substr(0, eq)) == key) {
+      return UrlDecode(pair.substr(eq + 1));
+    }
+    if (amp == std::string::npos) break;
+    pos = amp + 1;
+  }
+  return "";
+}
+
+void HttpServer::Route(const std::string& method, const std::string& path,
+                       Handler h) {
+  routes_[method + " " + path] = std::move(h);
+}
+
+bool HttpServer::Listen(const std::string& host, int port) {
+  listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return false;
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr =
+      host.empty() ? INADDR_ANY : inet_addr(host.c_str());
+  if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    return false;
+  if (listen(listen_fd_, 64) != 0) return false;
+  socklen_t len = sizeof(addr);
+  getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+  return true;
+}
+
+void HttpServer::Serve() {
+  while (!stopping_.load()) {
+    pollfd pfd{listen_fd_, POLLIN, 0};
+    int r = poll(&pfd, 1, 200);  // wake periodically to observe stopping_
+    if (r <= 0) continue;
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) continue;
+    in_flight_.fetch_add(1);
+    std::lock_guard<std::mutex> lock(workers_mu_);
+    workers_.emplace_back([this, fd] {
+      HandleConn(fd);
+      in_flight_.fetch_sub(1);
+    });
+  }
+}
+
+void HttpServer::Start() {
+  accept_thread_ = std::thread([this] { Serve(); });
+}
+
+void HttpServer::Shutdown() {
+  stopping_.store(true);
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (listen_fd_ >= 0) {
+    close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  // Graceful drain (reference: 5 s shutdown context, main.go:51-55). Joining
+  // rather than detaching: a handler thread that outlived a timed wait would
+  // use the freed server object. Worst case is bounded by the handlers' own
+  // socket timeouts; in-cluster, kubelet's grace period caps it anyway.
+  std::lock_guard<std::mutex> lock(workers_mu_);
+  for (auto& t : workers_) {
+    if (t.joinable()) t.join();
+  }
+  workers_.clear();
+}
+
+void HttpServer::HandleConn(int fd) {
+  timeval tv{75, 0};  // idle-read guard just above the 60 s proxy timeout
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+  std::string raw;
+  size_t header_end = 0;
+  if (!ReadRequest(fd, &raw, &header_end)) {
+    close(fd);
+    return;
+  }
+
+  HttpRequest req;
+  {
+    std::istringstream hs(raw.substr(0, header_end));
+    std::string line;
+    std::getline(hs, line);
+    std::istringstream rl(line);
+    std::string target, version;
+    rl >> req.method >> target >> version;
+    size_t q = target.find('?');
+    req.path = q == std::string::npos ? target : target.substr(0, q);
+    if (q != std::string::npos) req.query = target.substr(q + 1);
+    while (std::getline(hs, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = ToLower(line.substr(0, colon));
+      size_t vstart = line.find_first_not_of(' ', colon + 1);
+      req.headers[key] =
+          vstart == std::string::npos ? "" : line.substr(vstart);
+    }
+  }
+  size_t content_length = 0;
+  auto it = req.headers.find("content-length");
+  if (it != req.headers.end()) content_length = strtoul(it->second.c_str(), nullptr, 10);
+  req.body = raw.substr(header_end);
+  while (req.body.size() < content_length) {
+    char buf[8192];
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n <= 0) break;
+    req.body.append(buf, static_cast<size_t>(n));
+  }
+
+  HttpResponse resp;
+  auto route = routes_.find(req.method + " " + req.path);
+  if (route == routes_.end()) route = routes_.find("* " + req.path);
+  if (route == routes_.end()) {
+    resp.status = 404;
+    resp.body = "404 page not found\n";
+  } else {
+    resp = route->second(req);
+  }
+
+  std::ostringstream out;
+  out << "HTTP/1.1 " << resp.status << " " << StatusText(resp.status)
+      << "\r\n";
+  if (!resp.headers.count("Content-Type"))
+    out << "Content-Type: text/plain; charset=utf-8\r\n";
+  for (const auto& [k, v] : resp.headers) out << k << ": " << v << "\r\n";
+  out << "Content-Length: " << resp.body.size() << "\r\nConnection: close\r\n\r\n";
+  out << resp.body;
+  SendAll(fd, out.str());
+  close(fd);
+}
+
+// ---- client ----
+
+bool ParseUrl(const std::string& url, bool* tls, std::string* host, int* port,
+              std::string* path) {
+  std::string rest;
+  if (url.rfind("https://", 0) == 0) {
+    *tls = true;
+    rest = url.substr(8);
+    *port = 443;
+  } else if (url.rfind("http://", 0) == 0) {
+    *tls = false;
+    rest = url.substr(7);
+    *port = 80;
+  } else {
+    return false;
+  }
+  size_t slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
+  *path = slash == std::string::npos ? "/" : rest.substr(slash);
+  size_t colon = hostport.rfind(':');
+  if (colon != std::string::npos) {
+    *host = hostport.substr(0, colon);
+    *port = atoi(hostport.substr(colon + 1).c_str());
+  } else {
+    *host = hostport;
+  }
+  return !host->empty();
+}
+
+namespace {
+
+int ConnectTcp(const std::string& host, int port, int timeout_s,
+               std::string* error) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) !=
+      0) {
+    *error = "DNS resolution failed for " + host;
+    return -1;
+  }
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    timeval tv{timeout_s, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) *error = "connection failed to " + host + ":" + std::to_string(port);
+  return fd;
+}
+
+bool ParseResponse(const std::string& raw, ClientResult* out) {
+  size_t header_end = raw.find("\r\n\r\n");
+  if (header_end == std::string::npos) return false;
+  std::istringstream hs(raw.substr(0, header_end));
+  std::string line;
+  std::getline(hs, line);
+  if (line.rfind("HTTP/", 0) != 0) return false;
+  size_t sp = line.find(' ');
+  out->status = atoi(line.c_str() + sp + 1);
+  while (std::getline(hs, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    size_t vstart = line.find_first_not_of(' ', colon + 1);
+    out->headers[ToLower(line.substr(0, colon))] =
+        vstart == std::string::npos ? "" : line.substr(vstart);
+  }
+  out->body = raw.substr(header_end + 4);
+  // chunked transfer decoding (the k8s apiserver chunks most responses)
+  auto te = out->headers.find("transfer-encoding");
+  if (te != out->headers.end() && te->second.find("chunked") != std::string::npos) {
+    std::string decoded;
+    size_t pos = 0;
+    while (pos < out->body.size()) {
+      size_t eol = out->body.find("\r\n", pos);
+      if (eol == std::string::npos) break;
+      long len = strtol(out->body.substr(pos, eol - pos).c_str(), nullptr, 16);
+      if (len <= 0) break;
+      decoded += out->body.substr(eol + 2, static_cast<size_t>(len));
+      pos = eol + 2 + static_cast<size_t>(len) + 2;
+    }
+    out->body = decoded;
+  }
+  return true;
+}
+
+}  // namespace
+
+ClientResult HttpDo(const std::string& method, const std::string& url,
+                    const std::map<std::string, std::string>& headers,
+                    const std::string& body, int timeout_s,
+                    const std::string& ca_file, bool insecure_tls) {
+  ClientResult result;
+  bool tls = false;
+  std::string host, path;
+  int port = 0;
+  if (!ParseUrl(url, &tls, &host, &port, &path)) {
+    result.error = "invalid URL: " + url;
+    return result;
+  }
+  int fd = ConnectTcp(host, port, timeout_s, &result.error);
+  if (fd < 0) return result;
+
+  std::ostringstream req;
+  req << method << " " << path << " HTTP/1.1\r\nHost: " << host << "\r\n";
+  for (const auto& [k, v] : headers) req << k << ": " << v << "\r\n";
+  req << "Content-Length: " << body.size() << "\r\nConnection: close\r\n\r\n"
+      << body;
+  std::string wire = req.str();
+
+  std::string raw;
+  if (tls) {
+    TlsConn conn;
+    if (!conn.Handshake(fd, host, ca_file, insecure_tls, &result.error)) {
+      close(fd);
+      return result;
+    }
+    if (!conn.WriteAll(wire, &result.error)) {
+      close(fd);
+      return result;
+    }
+    conn.ReadAll(&raw);
+  } else {
+    if (!SendAll(fd, wire)) {
+      result.error = "send failed";
+      close(fd);
+      return result;
+    }
+    char buf[16384];
+    ssize_t n;
+    while ((n = recv(fd, buf, sizeof(buf), 0)) > 0)
+      raw.append(buf, static_cast<size_t>(n));
+  }
+  close(fd);
+
+  if (!ParseResponse(raw, &result)) {
+    result.error = "malformed HTTP response";
+    return result;
+  }
+  result.ok = true;
+  return result;
+}
+
+}  // namespace spotter

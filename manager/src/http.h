@@ -1,0 +1,90 @@
+// Minimal HTTP/1.1 server + client for the spotter-tpu control plane.
+//
+// The reference control plane is Go net/http (apps/spotter-manager/
+// cmd/spotter-manager/main.go:24-44); this is the C++ equivalent: a
+// thread-per-connection blocking server (a control plane sees a handful of
+// concurrent requests) and a blocking client with per-request timeout used
+// by the /detect proxy (handlers.go:289-390) and the k8s transport.
+
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace spotter {
+
+struct HttpRequest {
+  std::string method;
+  std::string path;        // path only, query split off
+  std::string query;       // raw query string (no leading '?')
+  std::map<std::string, std::string> headers;  // keys lower-cased
+  std::string body;
+
+  // first value of a query parameter, "" if absent
+  std::string QueryParam(const std::string& key) const;
+};
+
+struct HttpResponse {
+  int status = 200;
+  std::map<std::string, std::string> headers;
+  std::string body;
+};
+
+using Handler = std::function<HttpResponse(const HttpRequest&)>;
+
+class HttpServer {
+ public:
+  // route key is "METHOD /path" or "* /path" (any method)
+  void Route(const std::string& method, const std::string& path, Handler h);
+  // binds + listens; returns false on bind failure. port 0 = ephemeral.
+  bool Listen(const std::string& host, int port);
+  int port() const { return port_; }
+  // serve until Shutdown(); runs accept loop in the calling thread
+  void Serve();
+  // serve in a background thread (tests)
+  void Start();
+  // stop accepting, wait for in-flight handlers (graceful drain,
+  // main.go:51-55's 5 s shutdown analog)
+  void Shutdown();
+
+ private:
+  void HandleConn(int fd);
+  std::map<std::string, Handler> routes_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::atomic<int> in_flight_{0};
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+};
+
+// ---- client ----
+
+struct ClientResult {
+  bool ok = false;          // transport-level success
+  std::string error;        // transport error message when !ok
+  int status = 0;
+  std::map<std::string, std::string> headers;
+  std::string body;
+};
+
+// url: http://host:port/path or https://host:port/path. TLS goes through
+// tls.h (dlopen'd libssl3). `timeout_s` covers connect+write+read, the
+// reference's 60 s client timeout (handlers.go:307-310).
+ClientResult HttpDo(const std::string& method, const std::string& url,
+                    const std::map<std::string, std::string>& headers,
+                    const std::string& body, int timeout_s,
+                    const std::string& ca_file = "",
+                    bool insecure_tls = false);
+
+// parse "http(s)://host[:port]/path" -> (tls, host, port, path)
+bool ParseUrl(const std::string& url, bool* tls, std::string* host, int* port,
+              std::string* path);
+
+}  // namespace spotter

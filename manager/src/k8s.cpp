@@ -1,0 +1,96 @@
+#include "k8s.h"
+
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+
+namespace spotter {
+
+namespace {
+
+constexpr char kSaDir[] = "/var/run/secrets/kubernetes.io/serviceaccount";
+
+std::string ReadFileOrEmpty(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) return "";
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  std::string s = ss.str();
+  // trim trailing whitespace/newlines from the token file
+  while (!s.empty() && (s.back() == '\n' || s.back() == '\r' || s.back() == ' '))
+    s.pop_back();
+  return s;
+}
+
+}  // namespace
+
+bool LoadK8sConfig(K8sConfig* cfg, std::string* error) {
+  const char* override_base = std::getenv("SPOTTER_K8S_BASE");
+  if (override_base && *override_base) {
+    cfg->base_url = override_base;
+    const char* tok = std::getenv("SPOTTER_K8S_TOKEN");
+    if (tok) cfg->token = tok;
+    const char* ca = std::getenv("SPOTTER_K8S_CA");
+    if (ca) cfg->ca_file = ca;
+    cfg->insecure = std::getenv("SPOTTER_K8S_INSECURE") != nullptr;
+    return true;
+  }
+  const char* host = std::getenv("KUBERNETES_SERVICE_HOST");
+  const char* port = std::getenv("KUBERNETES_SERVICE_PORT");
+  if (!host || !port) {
+    *error =
+        "not in cluster: KUBERNETES_SERVICE_HOST/PORT unset and no "
+        "SPOTTER_K8S_BASE override";
+    return false;
+  }
+  cfg->base_url = std::string("https://") + host + ":" + port;
+  cfg->token_file = std::string(kSaDir) + "/token";
+  cfg->ca_file = std::string(kSaDir) + "/ca.crt";
+  return true;
+}
+
+std::string K8sClient::RayServicePath(const std::string& ns,
+                                      const std::string& name) {
+  // ray.io/v1: KubeRay >=1.1 serves v1 and 1.2 removed v1alpha1, so the
+  // reference's v1alpha1 GVR (handlers.go:153) would 404 against the
+  // operator version scripts/1_cluster_setup.sh installs (1.3.1).
+  return "/apis/ray.io/v1/namespaces/" + ns + "/rayservices/" + name;
+}
+
+std::string K8sClient::BearerToken() {
+  // Projected SA tokens rotate on disk (~1 h TTL); re-read per request the
+  // way client-go's transport does, or long-lived managers start getting 401s.
+  if (!cfg_.token_file.empty()) {
+    std::string tok = ReadFileOrEmpty(cfg_.token_file);
+    if (!tok.empty()) return tok;
+  }
+  return cfg_.token;
+}
+
+ClientResult K8sClient::ApplyRayService(const std::string& ns,
+                                        const std::string& name,
+                                        const std::string& manifest_yaml) {
+  std::map<std::string, std::string> headers{
+      {"Content-Type", "application/apply-patch+yaml"},
+      {"Accept", "application/json"},
+  };
+  std::string token = BearerToken();
+  if (!token.empty()) headers["Authorization"] = "Bearer " + token;
+  // FieldManager + Force exactly as the reference's ApplyOptions
+  // (handlers.go:168-172)
+  std::string url = cfg_.base_url + RayServicePath(ns, name) +
+                    "?fieldManager=spotter-manager&force=true";
+  return HttpDo("PATCH", url, headers, manifest_yaml, 30, cfg_.ca_file,
+                cfg_.insecure);
+}
+
+ClientResult K8sClient::DeleteRayService(const std::string& ns,
+                                         const std::string& name) {
+  std::map<std::string, std::string> headers{{"Accept", "application/json"}};
+  std::string token = BearerToken();
+  if (!token.empty()) headers["Authorization"] = "Bearer " + token;
+  return HttpDo("DELETE", cfg_.base_url + RayServicePath(ns, name), headers,
+                "", 30, cfg_.ca_file, cfg_.insecure);
+}
+
+}  // namespace spotter

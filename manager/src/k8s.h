@@ -1,0 +1,52 @@
+// Kubernetes client: in-cluster config + RayService server-side apply/delete.
+//
+// The reference uses client-go's dynamic client (handlers.go:30-41, 152-173,
+// 227-231). A dynamic client's two verbs used there map to two plain REST
+// calls, so this client speaks to the apiserver directly:
+//   apply  -> PATCH /apis/ray.io/v1alpha1/namespaces/{ns}/rayservices/{name}
+//             ?fieldManager=spotter-manager&force=true
+//             Content-Type: application/apply-patch+yaml  (body = manifest)
+//   delete -> DELETE same path
+// Server-side apply accepts the YAML manifest verbatim, which removes the
+// reference's YAML-decode step (handlers.go:124-150) entirely.
+
+#pragma once
+
+#include <string>
+
+#include "http.h"
+
+namespace spotter {
+
+struct K8sConfig {
+  std::string base_url;    // https://host:port
+  std::string token;       // static bearer token ("" = no auth header)
+  std::string token_file;  // re-read per request when set (SA token rotation)
+  std::string ca_file;     // CA bundle path ("" = system roots)
+  bool insecure = false;   // tests only
+};
+
+// In-cluster discovery: KUBERNETES_SERVICE_HOST/PORT + serviceaccount token
+// and CA mount (rest.InClusterConfig equivalent). SPOTTER_K8S_BASE overrides
+// the URL (how tests point at a fake apiserver, the dynamicfake analog —
+// SURVEY.md §4.1). Returns false if neither is available.
+bool LoadK8sConfig(K8sConfig* cfg, std::string* error);
+
+class K8sClient {
+ public:
+  explicit K8sClient(K8sConfig cfg) : cfg_(std::move(cfg)) {}
+
+  // Server-side apply of a RayService manifest. Returns apiserver response.
+  ClientResult ApplyRayService(const std::string& ns, const std::string& name,
+                               const std::string& manifest_yaml);
+  ClientResult DeleteRayService(const std::string& ns, const std::string& name);
+
+  const K8sConfig& config() const { return cfg_; }
+
+ private:
+  std::string RayServicePath(const std::string& ns, const std::string& name);
+  std::string BearerToken();
+  K8sConfig cfg_;
+};
+
+}  // namespace spotter

@@ -1,0 +1,60 @@
+// spotter-manager: TPU-serving control plane.
+//
+// C++ analog of the reference's Go entrypoint (cmd/spotter-manager/
+// main.go:17-59): k8s client setup, four routes, :8080, graceful drain on
+// SIGINT/SIGTERM.
+
+#include <signal.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+
+#include "handlers.h"
+
+namespace {
+std::atomic<bool> g_stop{false};
+void OnSignal(int) { g_stop.store(true); }
+}  // namespace
+
+int main(int argc, char** argv) {
+  spotter::ManagerOptions opts;
+  int port = 8080;
+  for (int i = 1; i < argc - 1; ++i) {
+    std::string a = argv[i];
+    if (a == "--port") port = atoi(argv[++i]);
+    else if (a == "--web-dir") opts.web_dir = argv[++i];
+    else if (a == "--configs-dir") opts.configs_dir = argv[++i];
+    else if (a == "--template") opts.template_file = argv[++i];
+    else if (a == "--backend-url") opts.backend_url = argv[++i];
+    else if (a == "--namespace") opts.ns = argv[++i];
+  }
+  if (const char* b = std::getenv("SPOTTER_BACKEND_URL")) opts.backend_url = b;
+
+  spotter::K8sConfig kcfg;
+  std::string err;
+  if (!spotter::LoadK8sConfig(&kcfg, &err)) {
+    fprintf(stderr, "Failed to set up Kubernetes client: %s\n", err.c_str());
+    return 1;
+  }
+  spotter::K8sClient client(kcfg);
+
+  spotter::HttpServer server;
+  spotter::RegisterRoutes(&server, opts, &client);
+  if (!server.Listen("", port)) {
+    fprintf(stderr, "Failed to listen on :%d\n", port);
+    return 1;
+  }
+  printf("Starting server on :%d (k8s=%s backend=%s)\n", server.port(),
+         kcfg.base_url.c_str(), opts.backend_url.c_str());
+  fflush(stdout);
+
+  signal(SIGINT, OnSignal);
+  signal(SIGTERM, OnSignal);
+  server.Start();
+  while (!g_stop.load()) usleep(100000);
+  printf("Shutting down server...\n");
+  server.Shutdown();
+  printf("Server gracefully stopped\n");
+  return 0;
+}

@@ -1,0 +1,153 @@
+#include "tls.h"
+
+#include <dlfcn.h>
+
+#include <mutex>
+
+namespace spotter {
+
+namespace {
+
+// Hand-declared OpenSSL 3 client API (no -dev headers in the image).
+struct OpenSsl {
+  void* (*TLS_client_method)();
+  void* (*SSL_CTX_new)(void*);
+  void (*SSL_CTX_free)(void*);
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+  int (*SSL_CTX_set_default_verify_paths)(void*);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  void* (*SSL_new)(void*);
+  void (*SSL_free)(void*);
+  int (*SSL_set_fd)(void*, int);
+  int (*SSL_connect)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_shutdown)(void*);
+  long (*SSL_ctrl)(void*, int, long, void*);
+  int (*SSL_set1_host)(void*, const char*);
+  int (*SSL_get_error)(const void*, int);
+  bool ok = false;
+};
+
+constexpr int kSslCtrlSetTlsextHostname = 55;  // SSL_CTRL_SET_TLSEXT_HOSTNAME
+constexpr int kTlsextNametypeHostname = 0;
+constexpr int kSslVerifyPeer = 1;
+constexpr int kSslVerifyNone = 0;
+
+const OpenSsl& Lib() {
+  static OpenSsl lib = [] {
+    OpenSsl l{};
+    // libssl3 links libcrypto3 itself; load with GLOBAL so its deps resolve
+    void* h = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) return l;
+    auto sym = [h](const char* name) { return dlsym(h, name); };
+    l.TLS_client_method = reinterpret_cast<void* (*)()>(sym("TLS_client_method"));
+    l.SSL_CTX_new = reinterpret_cast<void* (*)(void*)>(sym("SSL_CTX_new"));
+    l.SSL_CTX_free = reinterpret_cast<void (*)(void*)>(sym("SSL_CTX_free"));
+    l.SSL_CTX_load_verify_locations =
+        reinterpret_cast<int (*)(void*, const char*, const char*)>(
+            sym("SSL_CTX_load_verify_locations"));
+    l.SSL_CTX_set_default_verify_paths =
+        reinterpret_cast<int (*)(void*)>(sym("SSL_CTX_set_default_verify_paths"));
+    l.SSL_CTX_set_verify = reinterpret_cast<void (*)(void*, int, void*)>(
+        sym("SSL_CTX_set_verify"));
+    l.SSL_new = reinterpret_cast<void* (*)(void*)>(sym("SSL_new"));
+    l.SSL_free = reinterpret_cast<void (*)(void*)>(sym("SSL_free"));
+    l.SSL_set_fd = reinterpret_cast<int (*)(void*, int)>(sym("SSL_set_fd"));
+    l.SSL_connect = reinterpret_cast<int (*)(void*)>(sym("SSL_connect"));
+    l.SSL_read = reinterpret_cast<int (*)(void*, void*, int)>(sym("SSL_read"));
+    l.SSL_write =
+        reinterpret_cast<int (*)(void*, const void*, int)>(sym("SSL_write"));
+    l.SSL_shutdown = reinterpret_cast<int (*)(void*)>(sym("SSL_shutdown"));
+    l.SSL_ctrl =
+        reinterpret_cast<long (*)(void*, int, long, void*)>(sym("SSL_ctrl"));
+    l.SSL_set1_host =
+        reinterpret_cast<int (*)(void*, const char*)>(sym("SSL_set1_host"));
+    l.SSL_get_error =
+        reinterpret_cast<int (*)(const void*, int)>(sym("SSL_get_error"));
+    l.ok = l.TLS_client_method && l.SSL_CTX_new && l.SSL_new && l.SSL_connect &&
+           l.SSL_read && l.SSL_write && l.SSL_ctrl && l.SSL_set1_host;
+    return l;
+  }();
+  return lib;
+}
+
+}  // namespace
+
+bool TlsAvailable() { return Lib().ok; }
+
+TlsConn::~TlsConn() {
+  const OpenSsl& l = Lib();
+  if (ssl_ && l.ok) {
+    l.SSL_shutdown(ssl_);
+    l.SSL_free(ssl_);
+  }
+  if (ctx_ && l.ok) l.SSL_CTX_free(ctx_);
+}
+
+bool TlsConn::Handshake(int fd, const std::string& host,
+                        const std::string& ca_file, bool insecure,
+                        std::string* error) {
+  const OpenSsl& l = Lib();
+  if (!l.ok) {
+    *error = "libssl.so.3 unavailable";
+    return false;
+  }
+  ctx_ = l.SSL_CTX_new(l.TLS_client_method());
+  if (!ctx_) {
+    *error = "SSL_CTX_new failed";
+    return false;
+  }
+  if (insecure) {
+    l.SSL_CTX_set_verify(ctx_, kSslVerifyNone, nullptr);
+  } else {
+    if (!ca_file.empty()) {
+      if (l.SSL_CTX_load_verify_locations(ctx_, ca_file.c_str(), nullptr) != 1) {
+        *error = "failed to load CA file " + ca_file;
+        return false;
+      }
+    } else if (l.SSL_CTX_set_default_verify_paths) {
+      l.SSL_CTX_set_default_verify_paths(ctx_);
+    }
+    l.SSL_CTX_set_verify(ctx_, kSslVerifyPeer, nullptr);
+  }
+  ssl_ = l.SSL_new(ctx_);
+  if (!ssl_) {
+    *error = "SSL_new failed";
+    return false;
+  }
+  l.SSL_ctrl(ssl_, kSslCtrlSetTlsextHostname, kTlsextNametypeHostname,
+             const_cast<char*>(host.c_str()));
+  if (!insecure) l.SSL_set1_host(ssl_, host.c_str());
+  l.SSL_set_fd(ssl_, fd);
+  if (l.SSL_connect(ssl_) != 1) {
+    *error = "TLS handshake with " + host + " failed";
+    return false;
+  }
+  return true;
+}
+
+bool TlsConn::WriteAll(const std::string& data, std::string* error) {
+  const OpenSsl& l = Lib();
+  size_t off = 0;
+  while (off < data.size()) {
+    int n = l.SSL_write(ssl_, data.data() + off,
+                        static_cast<int>(data.size() - off));
+    if (n <= 0) {
+      *error = "TLS write failed";
+      return false;
+    }
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+void TlsConn::ReadAll(std::string* out) {
+  const OpenSsl& l = Lib();
+  char buf[16384];
+  int n;
+  while ((n = l.SSL_read(ssl_, buf, sizeof(buf))) > 0)
+    out->append(buf, static_cast<size_t>(n));
+}
+
+}  // namespace spotter

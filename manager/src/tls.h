@@ -1,0 +1,35 @@
+// TLS client transport over dlopen'd libssl.so.3.
+//
+// The build image ships the OpenSSL 3 runtime but no -dev headers, so the
+// needed client-side API surface (stable across OpenSSL 3.x) is declared by
+// hand and resolved at runtime with dlopen/dlsym. Used only for the
+// manager -> k8s-apiserver leg (handlers.go:30-41 uses client-go's HTTPS
+// transport for the same hop); in-cluster CA comes from the serviceaccount
+// mount.
+
+#pragma once
+
+#include <string>
+
+namespace spotter {
+
+// true if libssl.so.3 + libcrypto.so.3 loaded and symbols resolved
+bool TlsAvailable();
+
+class TlsConn {
+ public:
+  ~TlsConn();
+  // TLS handshake over an already-connected socket. `ca_file` empty = system
+  // default verify paths; `insecure` skips verification (tests only).
+  bool Handshake(int fd, const std::string& host, const std::string& ca_file,
+                 bool insecure, std::string* error);
+  bool WriteAll(const std::string& data, std::string* error);
+  // read to EOF / close_notify
+  void ReadAll(std::string* out);
+
+ private:
+  void* ssl_ = nullptr;
+  void* ctx_ = nullptr;
+};
+
+}  // namespace spotter

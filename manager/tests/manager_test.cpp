@@ -1,0 +1,377 @@
+// Handler tests against an in-process fake apiserver + fake Ray backend.
+//
+// Mirrors the reference's Go test strategy (SURVEY.md §4.1): the fake
+// apiserver records the request (verb, path, content-type, body) the way
+// dynamicfake's PrependReactor records PatchActions; the proxy tests run
+// against an httptest-style in-process backend; "backend down" uses a dead
+// port and asserts the 502 message prefix.
+
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../src/handlers.h"
+
+namespace {
+
+int g_failures = 0;
+
+#define EXPECT(cond, msg)                                                    \
+  do {                                                                       \
+    if (!(cond)) {                                                           \
+      fprintf(stderr, "FAIL %s:%d %s — %s\n", __FILE__, __LINE__, __func__, \
+              msg);                                                          \
+      ++g_failures;                                                          \
+    }                                                                        \
+  } while (0)
+
+inline std::string Str(const std::string& s) { return s; }
+inline std::string Str(int v) { return std::to_string(v); }
+
+#define EXPECT_EQ(a, b)                                                       \
+  do {                                                                        \
+    auto va = (a);                                                            \
+    auto vb = (b);                                                            \
+    if (!(va == vb)) {                                                        \
+      fprintf(stderr, "FAIL %s:%d %s — %s != %s\n", __FILE__, __LINE__,      \
+              __func__, Str(va).c_str(), Str(vb).c_str());                    \
+      ++g_failures;                                                           \
+    }                                                                         \
+  } while (0)
+
+#define EXPECT_CONTAINS(haystack, needle)                                    \
+  do {                                                                       \
+    std::string h = (haystack);                                              \
+    if (h.find(needle) == std::string::npos) {                               \
+      fprintf(stderr, "FAIL %s:%d %s — '%s' not in '%s'\n", __FILE__,       \
+              __LINE__, __func__, std::string(needle).c_str(),               \
+              h.substr(0, 200).c_str());                                     \
+      ++g_failures;                                                          \
+    }                                                                        \
+  } while (0)
+
+// records every request; replies with a scripted (status, body)
+struct FakeServer {
+  spotter::HttpServer server;
+  std::mutex mu;
+  std::vector<spotter::HttpRequest> requests;
+  int reply_status = 200;
+  std::string reply_body = "{}";
+  std::map<std::string, std::string> reply_headers;
+
+  void Start() {
+    auto handler = [this](const spotter::HttpRequest& r) {
+      std::lock_guard<std::mutex> lock(mu);
+      requests.push_back(r);
+      spotter::HttpResponse resp;
+      resp.status = reply_status;
+      resp.body = reply_body;
+      resp.headers = reply_headers;
+      return resp;
+    };
+    // catch-all routes for the paths under test
+    server.Route(
+        "*", "/apis/ray.io/v1/namespaces/spotter/rayservices/spotter-ray-service",
+        handler);
+    server.Route("*", "/detect", handler);
+    bool ok = server.Listen("127.0.0.1", 0);
+    EXPECT(ok, "fake server failed to listen");
+    server.Start();
+  }
+  std::string Base() {
+    return "http://127.0.0.1:" + std::to_string(server.port());
+  }
+  spotter::HttpRequest Last() {
+    std::lock_guard<std::mutex> lock(mu);
+    return requests.back();
+  }
+  size_t Count() {
+    std::lock_guard<std::mutex> lock(mu);
+    return requests.size();
+  }
+  void Stop() { server.Shutdown(); }
+};
+
+// temp fixture dir with web/index.html + configs/<template> (the t.TempDir()
+// + os.Chdir analog, handlers_test.go:24-42)
+struct Fixture {
+  std::string dir;
+  spotter::ManagerOptions opts;
+
+  explicit Fixture(const std::string& tmpl_yaml) {
+    char buf[] = "/tmp/spotter_mgr_XXXXXX";
+    dir = mkdtemp(buf);
+    mkdir((dir + "/web").c_str(), 0755);
+    mkdir((dir + "/configs").c_str(), 0755);
+    WriteFile(dir + "/web/index.html", "<html>Spotter TPU Manager</html>");
+    WriteFile(dir + "/configs/rayservice-tpu-template.yaml", tmpl_yaml);
+    opts.web_dir = dir + "/web";
+    opts.configs_dir = dir + "/configs";
+  }
+  static void WriteFile(const std::string& path, const std::string& content) {
+    FILE* f = fopen(path.c_str(), "w");
+    fwrite(content.data(), 1, content.size(), f);
+    fclose(f);
+  }
+};
+
+const char kTemplate[] =
+    "apiVersion: ray.io/v1\n"
+    "kind: RayService\n"
+    "metadata:\n"
+    "  name: spotter-ray-service\n"
+    "spec:\n"
+    "  image: {{.DockerImage}}\n"
+    "  model: {{.ModelName}}\n"
+    "  accelerator: {{.Accelerator}}\n"
+    "  topology: {{.Topology}}\n"
+    "  workers: {{.NumWorkers}}\n";
+
+spotter::HttpRequest MakeReq(const std::string& method, const std::string& path,
+                             const std::string& query,
+                             const std::string& body = "") {
+  spotter::HttpRequest r;
+  r.method = method;
+  r.path = path;
+  r.query = query;
+  r.body = body;
+  return r;
+}
+
+void TestRenderTemplate() {
+  std::string out, err;
+  bool ok = spotter::RenderTemplate(
+      "a {{.X}} b {{ .Y }} c", {{"X", "1"}, {"Y", "2"}}, &out, &err);
+  EXPECT(ok, err.c_str());
+  EXPECT_CONTAINS(out, "a 1 b 2 c");
+
+  ok = spotter::RenderTemplate("a {{.Missing}} b", {{"X", "1"}}, &out, &err);
+  EXPECT(!ok, "unknown param must fail");
+  EXPECT_CONTAINS(err, "unknown param");
+
+  ok = spotter::RenderTemplate("a {{.X b", {{"X", "1"}}, &out, &err);
+  EXPECT(!ok, "unterminated ref must fail");
+}
+
+void TestFrontend() {
+  Fixture fx(kTemplate);
+  auto resp = spotter::ServeFrontend(fx.opts, MakeReq("GET", "/", ""));
+  EXPECT_EQ(resp.status, 200);
+  EXPECT_CONTAINS(resp.body, "Spotter TPU Manager");
+  EXPECT_CONTAINS(resp.headers["Cache-Control"], "no-cache");
+  EXPECT_EQ(std::string(resp.headers["Expires"]), std::string("0"));
+}
+
+void TestDeploySuccess() {
+  Fixture fx(kTemplate);
+  FakeServer api;
+  api.Start();
+  setenv("SPOTTER_K8S_BASE", api.Base().c_str(), 1);
+  spotter::K8sConfig kcfg;
+  std::string err;
+  spotter::LoadK8sConfig(&kcfg, &err);
+  spotter::K8sClient client(kcfg);
+
+  auto resp = spotter::HandleDeploy(
+      fx.opts, &client,
+      MakeReq("POST", "/deploy",
+              "dockerimage=registry%2Fspotter%3Av2&model=PekingU/rtdetr_v2_r18vd"
+              "&topology=2x2&numworkers=4"));
+  EXPECT_EQ(resp.status, 200);
+  EXPECT_CONTAINS(resp.body, "Successfully deployed");
+
+  auto req = api.Last();
+  EXPECT_EQ(std::string(req.method), std::string("PATCH"));
+  EXPECT_CONTAINS(req.query, "fieldManager=spotter-manager");
+  EXPECT_CONTAINS(req.query, "force=true");
+  EXPECT_CONTAINS(req.headers.at("content-type"), "apply-patch+yaml");
+  // rendered params land inside the manifest (NestedString assertions analog)
+  EXPECT_CONTAINS(req.body, "image: registry/spotter:v2");
+  EXPECT_CONTAINS(req.body, "model: PekingU/rtdetr_v2_r18vd");
+  EXPECT_CONTAINS(req.body, "topology: 2x2");
+  EXPECT_CONTAINS(req.body, "workers: 4");
+  EXPECT_CONTAINS(req.body, "accelerator: tpu-v5-lite-podslice");  // default
+  api.Stop();
+}
+
+void TestDeployValidation() {
+  Fixture fx(kTemplate);
+  spotter::K8sClient client({});
+
+  auto resp = spotter::HandleDeploy(fx.opts, &client,
+                                    MakeReq("POST", "/deploy", ""));
+  EXPECT_EQ(resp.status, 400);
+  EXPECT_CONTAINS(resp.body, "dockerimage");
+
+  resp = spotter::HandleDeploy(
+      fx.opts, &client,
+      MakeReq("POST", "/deploy", "dockerimage=img%0Aevil%3A%20true"));
+  EXPECT_EQ(resp.status, 400);  // YAML injection rejected
+
+  resp = spotter::HandleDeploy(fx.opts, &client,
+                               MakeReq("GET", "/deploy", "dockerimage=x"));
+  EXPECT_EQ(resp.status, 405);
+}
+
+void TestDeployApiserverError() {
+  Fixture fx(kTemplate);
+  FakeServer api;
+  api.reply_status = 500;
+  api.reply_body = "simulated apply error";
+  api.Start();
+  setenv("SPOTTER_K8S_BASE", api.Base().c_str(), 1);
+  spotter::K8sConfig kcfg;
+  std::string err;
+  spotter::LoadK8sConfig(&kcfg, &err);
+  spotter::K8sClient client(kcfg);
+
+  auto resp = spotter::HandleDeploy(
+      fx.opts, &client, MakeReq("POST", "/deploy", "dockerimage=img"));
+  EXPECT_EQ(resp.status, 500);
+  EXPECT_CONTAINS(resp.body, "simulated apply error");
+  api.Stop();
+}
+
+void TestDeployMissingTemplate() {
+  Fixture fx(kTemplate);
+  fx.opts.template_file = "nonexistent.yaml";
+  spotter::K8sClient client({});
+  auto resp = spotter::HandleDeploy(fx.opts, &client,
+                                    MakeReq("POST", "/deploy", "dockerimage=x"));
+  EXPECT_EQ(resp.status, 500);
+  EXPECT_CONTAINS(resp.body, "template");
+}
+
+void TestDeleteVariants() {
+  Fixture fx(kTemplate);
+  struct Case {
+    int api_status;
+    int want_status;
+    const char* want_body;
+  } cases[] = {
+      {200, 200, "Successfully deleted"},
+      {404, 200, "did not exist"},
+      {500, 500, "Error deleting"},
+  };
+  for (const auto& c : cases) {
+    FakeServer api;
+    api.reply_status = c.api_status;
+    api.Start();
+    setenv("SPOTTER_K8S_BASE", api.Base().c_str(), 1);
+    spotter::K8sConfig kcfg;
+    std::string err;
+    spotter::LoadK8sConfig(&kcfg, &err);
+    spotter::K8sClient client(kcfg);
+
+    auto resp =
+        spotter::HandleDelete(fx.opts, &client, MakeReq("POST", "/delete", ""));
+    EXPECT_EQ(resp.status, c.want_status);
+    EXPECT_CONTAINS(resp.body, c.want_body);
+    EXPECT_EQ(std::string(api.Last().method), std::string("DELETE"));
+    api.Stop();
+  }
+}
+
+void TestProxySuccess() {
+  FakeServer backend;
+  backend.reply_status = 200;
+  backend.reply_body = "{\"amenities_description\": \"The property contains: sofa.\"}";
+  backend.reply_headers["Content-Type"] = "application/json";
+  backend.Start();
+
+  spotter::ManagerOptions opts;
+  opts.backend_url = backend.Base() + "/detect";
+  auto resp = spotter::HandleDetectProxy(
+      opts, MakeReq("POST", "/detect", "", "{\"image_urls\": [\"http://x/y.jpg\"]}"));
+  EXPECT_EQ(resp.status, 200);
+  EXPECT_CONTAINS(resp.body, "sofa");
+  EXPECT_CONTAINS(resp.headers["Content-Type"], "application/json");
+  // body + content-type forwarded to the backend (handlers.go:586-592 analog)
+  auto seen = backend.Last();
+  EXPECT_CONTAINS(seen.body, "image_urls");
+  EXPECT_CONTAINS(seen.headers.at("content-type"), "application/json");
+  backend.Stop();
+}
+
+void TestProxyBackendDown() {
+  spotter::ManagerOptions opts;
+  opts.backend_url = "http://127.0.0.1:9/detect";  // dead port
+  opts.proxy_timeout_s = 2;
+  auto resp =
+      spotter::HandleDetectProxy(opts, MakeReq("POST", "/detect", "", "{}"));
+  EXPECT_EQ(resp.status, 502);
+  EXPECT(resp.body.rfind("Failed to reach backend service", 0) == 0,
+         "502 message must start with the reference prefix");
+}
+
+void TestProxyBackendErrorPassthrough() {
+  FakeServer backend;
+  backend.reply_status = 500;
+  backend.reply_body = "backend exploded";
+  backend.Start();
+  spotter::ManagerOptions opts;
+  opts.backend_url = backend.Base() + "/detect";
+  auto resp =
+      spotter::HandleDetectProxy(opts, MakeReq("POST", "/detect", "", "{}"));
+  EXPECT_EQ(resp.status, 500);
+  EXPECT_CONTAINS(resp.body, "backend exploded");
+  backend.Stop();
+}
+
+void TestEndToEndServer() {
+  // full wiring through real sockets: routes registered on a live server
+  Fixture fx(kTemplate);
+  FakeServer api;
+  api.Start();
+  setenv("SPOTTER_K8S_BASE", api.Base().c_str(), 1);
+  spotter::K8sConfig kcfg;
+  std::string err;
+  spotter::LoadK8sConfig(&kcfg, &err);
+  spotter::K8sClient client(kcfg);
+
+  spotter::HttpServer server;
+  spotter::RegisterRoutes(&server, fx.opts, &client);
+  EXPECT(server.Listen("127.0.0.1", 0), "listen");
+  server.Start();
+  std::string base = "http://127.0.0.1:" + std::to_string(server.port());
+
+  auto r = spotter::HttpDo("GET", base + "/", {}, "", 5);
+  EXPECT(r.ok, r.error.c_str());
+  EXPECT_EQ(r.status, 200);
+  EXPECT_CONTAINS(r.body, "Spotter TPU Manager");
+
+  r = spotter::HttpDo("POST", base + "/deploy?dockerimage=img:1", {}, "", 5);
+  EXPECT_EQ(r.status, 200);
+
+  r = spotter::HttpDo("GET", base + "/nope", {}, "", 5);
+  EXPECT_EQ(r.status, 404);
+
+  server.Shutdown();
+  api.Stop();
+}
+
+}  // namespace
+
+int main() {
+  TestRenderTemplate();
+  TestFrontend();
+  TestDeploySuccess();
+  TestDeployValidation();
+  TestDeployApiserverError();
+  TestDeployMissingTemplate();
+  TestDeleteVariants();
+  TestProxySuccess();
+  TestProxyBackendDown();
+  TestProxyBackendErrorPassthrough();
+  TestEndToEndServer();
+  if (g_failures == 0) {
+    printf("ALL MANAGER TESTS PASSED\n");
+    return 0;
+  }
+  fprintf(stderr, "%d failure(s)\n", g_failures);
+  return 1;
+}

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Cluster bootstrap: KubeRay operator into the spotter namespace.
+# Reference analog: scripts/1_microk8s_setup.sh (microk8s + helm kuberay).
+# On GKE, the cluster must have a TPU node pool matching the accelerator/
+# topology passed to /deploy (e.g. ct5lp-hightpu-4t for tpu-v5-lite 2x2).
+set -euo pipefail
+
+NAMESPACE=${NAMESPACE:-spotter}
+
+helm repo add kuberay https://ray-project.github.io/kuberay-helm/ || true
+helm repo update
+helm upgrade --install kuberay-operator kuberay/kuberay-operator \
+  --version 1.3.1 --namespace "${NAMESPACE}" --create-namespace
+
+echo "KubeRay operator installed in namespace ${NAMESPACE}."

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Build + push the C++ manager image (reference analog: scripts/2_...sh).
+set -euo pipefail
+
+REGISTRY=${REGISTRY:-localhost:32000}
+TAG=${TAG:-latest}
+
+docker build -t "${REGISTRY}/spotter-tpu-manager:${TAG}" manager/
+docker push "${REGISTRY}/spotter-tpu-manager:${TAG}"
+echo "Pushed ${REGISTRY}/spotter-tpu-manager:${TAG}"

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Apply the manager manifests and print the UI URL (reference: scripts/3_...sh).
+set -euo pipefail
+
+kubectl apply -f manager/configs/spotter-manager-deployment.yaml
+kubectl -n spotter rollout restart deployment spotter-manager
+kubectl -n spotter rollout status deployment spotter-manager --timeout=120s
+
+NODE_PORT=$(kubectl -n spotter get svc spotter-manager -o jsonpath='{.spec.ports[0].nodePort}')
+NODE_IP=$(kubectl get nodes -o jsonpath='{.items[0].status.addresses[?(@.type=="InternalIP")].address}')
+echo "spotter-manager UI: http://${NODE_IP}:${NODE_PORT}/"

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Build + push the TPU serving image with baked converted weights
+# (reference analog: scripts/4_build_and_push_spotter_app.sh).
+set -euo pipefail
+
+REGISTRY=${REGISTRY:-localhost:32000}
+TAG=${TAG:-latest}
+MODEL_NAME=${MODEL_NAME:-PekingU/rtdetr_v2_r101vd}
+
+docker build --build-arg MODEL_NAME="${MODEL_NAME}" \
+  -t "${REGISTRY}/spotter-tpu:${TAG}" .
+docker push "${REGISTRY}/spotter-tpu:${TAG}"
+echo "Pushed ${REGISTRY}/spotter-tpu:${TAG} (model ${MODEL_NAME})"

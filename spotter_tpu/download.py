@@ -1,0 +1,44 @@
+"""Weight pre-conversion at image build time.
+
+The reference bakes torch weights into the serving image by running
+`spotter_download` during docker build (apps/spotter/Dockerfile:17,
+download.py:12-30) so pods start without network. The TPU analog converts
+the torch checkpoint to Flax params and writes the versioned Orbax cache
+(convert/loader.py); pod start then loads converted params directly and
+never imports torch.
+"""
+
+import logging
+import os
+import sys
+
+logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s")
+logger = logging.getLogger(__name__)
+
+
+def download(model_name: str) -> None:
+    from spotter_tpu.models import build_detector
+
+    logger.info("Pre-converting weights for %s", model_name)
+    built = build_detector(model_name)
+    n_params = sum(p.size for p in _leaves(built.params))
+    logger.info("Converted %s: %.1fM params cached", model_name, n_params / 1e6)
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def main() -> int:
+    model_name = os.environ.get("MODEL_NAME")
+    if not model_name:
+        logger.error("MODEL_NAME environment variable not set.")
+        return 1
+    download(model_name)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

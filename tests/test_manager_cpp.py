@@ -1,0 +1,35 @@
+"""Build + run the C++ manager test binary under pytest.
+
+Keeps `python -m pytest tests/` the single test entry point across the
+Python serving stack and the native control plane (the reference splits
+this across `go test` and `pytest` CI jobs — SURVEY.md §4.3).
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BUILD = REPO / "manager" / "build"
+
+
+@pytest.mark.skipif(
+    shutil.which("cmake") is None or shutil.which("g++") is None,
+    reason="C++ toolchain not available",
+)
+def test_manager_cpp_suite():
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    subprocess.run(
+        ["cmake", "-S", str(REPO / "manager"), "-B", str(BUILD), *gen],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", str(BUILD)], check=True, capture_output=True
+    )
+    result = subprocess.run(
+        [str(BUILD / "manager_test")], capture_output=True, text=True, timeout=120
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "ALL MANAGER TESTS PASSED" in result.stdout
