@@ -12,7 +12,7 @@ from pathlib import Path
 
 import numpy as np
 
-from spotter_tpu.models.configs import RTDetrConfig
+from spotter_tpu.models.configs import DetrConfig, RTDetrConfig
 
 logger = logging.getLogger(__name__)
 
@@ -79,5 +79,31 @@ def load_rtdetr_from_hf(model_name: str) -> tuple[RTDetrConfig, dict]:
     # checkpoint disagree — caching such a partial tree would serve a broken
     # model silently on every later pod start.
     params = convert_state_dict(model.state_dict(), rtdetr_rules(cfg), strict=True)
+    _save_cache(_cache_path(model_name), params)
+    return cfg, params
+
+
+def load_detr_from_hf(model_name: str) -> tuple[DetrConfig, dict]:
+    """Load + convert a DETR checkpoint (timm- or HF-backbone serialization)."""
+    from transformers import AutoConfig
+
+    hf_cfg = AutoConfig.from_pretrained(model_name)
+    cfg = DetrConfig.from_hf(hf_cfg)
+
+    cached = _load_cache(_cache_path(model_name))
+    if cached is not None:
+        logger.info("Loaded converted params for %s from cache", model_name)
+        return cfg, cached
+
+    import torch
+    from transformers import AutoModelForObjectDetection
+
+    from spotter_tpu.convert.detr_rules import detr_rules
+    from spotter_tpu.convert.torch_to_jax import convert_state_dict
+
+    with torch.no_grad():
+        model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
+    naming = "timm" if hf_cfg.use_timm_backbone else "hf"
+    params = convert_state_dict(model.state_dict(), detr_rules(cfg, naming), strict=True)
     _save_cache(_cache_path(model_name), params)
     return cfg, params

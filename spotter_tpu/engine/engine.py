@@ -51,6 +51,8 @@ class BuiltDetector:
     num_top_queries: int = 300
     # extra static kwargs passed to module.apply (e.g. OWL-ViT text inputs)
     apply_kwargs: dict = field(default_factory=dict)
+    # DETR-style models consume the preprocess pixel mask (padded buckets)
+    needs_mask: bool = False
 
 
 def default_batch_buckets(max_batch: int = 8) -> tuple[int, ...]:
@@ -102,8 +104,9 @@ class InferenceEngine:
         post_fn = POSTPROCESS_KINDS[built.postprocess]
         k = built.num_top_queries
 
-        def forward(params, pixels, target_sizes):
-            out = built.module.apply({"params": params}, pixels, **built.apply_kwargs)
+        def forward(params, pixels, masks, target_sizes):
+            args = (pixels, masks) if built.needs_mask else (pixels,)
+            out = built.module.apply({"params": params}, *args, **built.apply_kwargs)
             if built.postprocess == "sigmoid_topk":
                 kk = min(k, out["logits"].shape[1] * out["logits"].shape[2])
                 return sigmoid_topk_postprocess(
@@ -131,8 +134,9 @@ class InferenceEngine:
             # device_put with the serving sharding so warmup compiles the
             # exact programs the traffic path will hit (no recompiles later)
             pixels = jax.device_put(np.zeros((b, h, w, 3), np.float32), self._in_sharding)
+            masks = jax.device_put(np.ones((b, h, w), np.float32), self._in_sharding)
             sizes = jax.device_put(np.ones((b, 2), np.float32), self._in_sharding)
-            jax.block_until_ready(self._forward(self.params, pixels, sizes))
+            jax.block_until_ready(self._forward(self.params, pixels, masks, sizes))
 
     def detect(self, images: list[Image.Image]) -> list[list[dict]]:
         """PIL images -> per-image lists of {"label", "score", "box"} dicts.
@@ -152,14 +156,16 @@ class InferenceEngine:
         t0 = time.monotonic()
         n = len(images)
         bucket = self.bucket_for(n)
-        pixels, _, sizes = batch_images(images, self.built.preprocess_spec)
+        pixels, masks, sizes = batch_images(images, self.built.preprocess_spec)
         if bucket > n:  # pad batch to the static bucket size
             pad = bucket - n
             pixels = np.concatenate([pixels, np.zeros((pad, *pixels.shape[1:]), pixels.dtype)])
+            masks = np.concatenate([masks, np.ones((pad, *masks.shape[1:]), masks.dtype)])
             sizes = np.concatenate([sizes, np.ones((pad, 2), sizes.dtype)])
         scores, labels, boxes = self._forward(
             self.params,
             jax.device_put(pixels, self._in_sharding),
+            jax.device_put(masks, self._in_sharding),
             jax.device_put(sizes, self._in_sharding),
         )
         scores, labels, boxes = jax.device_get((scores, labels, boxes))

@@ -5,12 +5,18 @@ checkpoint's config.json can be adapted 1:1 (`from_hf`), while staying plain
 frozen dataclasses — hashable, so they can be static args under jax.jit.
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 
 @dataclass(frozen=True)
 class ResNetConfig:
-    """RT-DETR's ResNet-D backbone (deep 3-conv stem, avg-pool downsample shortcuts)."""
+    """ResNet backbone in two flavors.
+
+    style "d": RT-DETR's "presnet" (deep 3-conv stem, avg-pool downsample
+    shortcuts — HF RTDetrResNetBackbone). style "v1": the classic
+    torchvision-style ResNet (single 7x7 stem, strided 1x1 projection
+    shortcuts — HF ResNetBackbone / timm resnet, the DETR backbone).
+    """
 
     num_channels: int = 3
     embedding_size: int = 64
@@ -20,6 +26,7 @@ class ResNetConfig:
     hidden_act: str = "relu"
     downsample_in_first_stage: bool = False
     downsample_in_bottleneck: bool = False
+    style: str = "d"  # "d" (RT-DETR ResNet-D) | "v1" (classic / DETR)
     # indices into (stem, stage1, ..., stage4); RT-DETR taps strides 8/16/32
     out_indices: tuple[int, ...] = (2, 3, 4)
 
@@ -34,6 +41,7 @@ class ResNetConfig:
             hidden_act=hf.hidden_act,
             downsample_in_first_stage=hf.downsample_in_first_stage,
             downsample_in_bottleneck=hf.downsample_in_bottleneck,
+            style="v1" if hf.model_type == "resnet" else "d",
             out_indices=tuple(hf.out_indices),
         )
 
@@ -112,6 +120,187 @@ class RTDetrConfig:
             layer_norm_eps=hf.layer_norm_eps,
             batch_norm_eps=hf.batch_norm_eps,
             id2label=tuple(sorted((int(k), v) for k, v in hf.id2label.items())),
+        )
+
+
+@dataclass(frozen=True)
+class DetrConfig:
+    """DETR (facebook/detr-resnet-*) — CNN backbone + vanilla enc-dec transformer.
+
+    Mirrors HF DetrConfig (configuration_detr.py); the reference serves this
+    family through the same AutoModel boundary (serve.py:199-205).
+    """
+
+    backbone: "ResNetConfig" = field(
+        default_factory=lambda: ResNetConfig(style="v1", out_indices=(4,))
+    )
+    num_labels: int = 91
+    d_model: int = 256
+    num_queries: int = 100
+    encoder_layers: int = 6
+    decoder_layers: int = 6
+    encoder_attention_heads: int = 8
+    decoder_attention_heads: int = 8
+    encoder_ffn_dim: int = 2048
+    decoder_ffn_dim: int = 2048
+    activation_function: str = "relu"
+    positional_encoding_temperature: float = 10000.0
+    layer_norm_eps: float = 1e-5  # torch nn.LayerNorm default (DETR never overrides)
+    id2label: tuple[tuple[int, str], ...] = ()
+
+    @property
+    def id2label_dict(self) -> dict[int, str]:
+        return dict(self.id2label)
+
+    @classmethod
+    def from_hf(cls, hf) -> "DetrConfig":
+        if hf.use_timm_backbone:
+            # timm checkpoints (facebook/detr-resnet-50/101) are all classic
+            # bottleneck ResNets; depth comes from the backbone name
+            depths = {"resnet50": (3, 4, 6, 3), "resnet101": (3, 4, 23, 3)}[hf.backbone]
+            backbone = ResNetConfig(style="v1", depths=depths, out_indices=(4,))
+        else:
+            backbone = replace(
+                ResNetConfig.from_hf(hf.backbone_config),
+                out_indices=(len(hf.backbone_config.depths),),
+            )
+        return cls(
+            backbone=backbone,
+            num_labels=hf.num_labels,
+            d_model=hf.d_model,
+            num_queries=hf.num_queries,
+            encoder_layers=hf.encoder_layers,
+            decoder_layers=hf.decoder_layers,
+            encoder_attention_heads=hf.encoder_attention_heads,
+            decoder_attention_heads=hf.decoder_attention_heads,
+            encoder_ffn_dim=hf.encoder_ffn_dim,
+            decoder_ffn_dim=hf.decoder_ffn_dim,
+            activation_function=hf.activation_function,
+            id2label=tuple(sorted((int(k), v) for k, v in hf.id2label.items())),
+        )
+
+
+@dataclass(frozen=True)
+class YolosConfig:
+    """YOLOS (hustvl/yolos-*) — plain ViT with appended detection tokens."""
+
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    image_size: tuple[int, int] = (800, 1344)
+    patch_size: int = 16
+    num_channels: int = 3
+    num_detection_tokens: int = 100
+    use_mid_position_embeddings: bool = True
+    qkv_bias: bool = True
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 91
+    id2label: tuple[tuple[int, str], ...] = ()
+
+    @property
+    def id2label_dict(self) -> dict[int, str]:
+        return dict(self.id2label)
+
+    @property
+    def grid_hw(self) -> tuple[int, int]:
+        return self.image_size[0] // self.patch_size, self.image_size[1] // self.patch_size
+
+    @classmethod
+    def from_hf(cls, hf) -> "YolosConfig":
+        return cls(
+            hidden_size=hf.hidden_size,
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            intermediate_size=hf.intermediate_size,
+            hidden_act=hf.hidden_act,
+            image_size=tuple(hf.image_size),
+            patch_size=hf.patch_size,
+            num_channels=hf.num_channels,
+            num_detection_tokens=hf.num_detection_tokens,
+            use_mid_position_embeddings=hf.use_mid_position_embeddings,
+            qkv_bias=hf.qkv_bias,
+            layer_norm_eps=hf.layer_norm_eps,
+            num_labels=hf.num_labels,
+            id2label=tuple(sorted((int(k), v) for k, v in hf.id2label.items())),
+        )
+
+
+@dataclass(frozen=True)
+class OwlViTTextConfig:
+    """CLIP-style text tower of OWL-ViT."""
+
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 8
+    max_position_embeddings: int = 16
+    hidden_act: str = "quick_gelu"
+    layer_norm_eps: float = 1e-5
+
+    @classmethod
+    def from_hf(cls, hf) -> "OwlViTTextConfig":
+        return cls(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            max_position_embeddings=hf.max_position_embeddings,
+            hidden_act=hf.hidden_act,
+            layer_norm_eps=hf.layer_norm_eps,
+        )
+
+
+@dataclass(frozen=True)
+class OwlViTVisionConfig:
+    """CLIP-style vision tower of OWL-ViT."""
+
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    image_size: int = 768
+    patch_size: int = 32
+    num_channels: int = 3
+    hidden_act: str = "quick_gelu"
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @classmethod
+    def from_hf(cls, hf) -> "OwlViTVisionConfig":
+        return cls(
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            image_size=hf.image_size,
+            patch_size=hf.patch_size,
+            num_channels=hf.num_channels,
+            hidden_act=hf.hidden_act,
+            layer_norm_eps=hf.layer_norm_eps,
+        )
+
+
+@dataclass(frozen=True)
+class OwlViTConfig:
+    """OWL-ViT open-vocabulary detector (google/owlvit-*)."""
+
+    text: OwlViTTextConfig = field(default_factory=OwlViTTextConfig)
+    vision: OwlViTVisionConfig = field(default_factory=OwlViTVisionConfig)
+    projection_dim: int = 512
+
+    @classmethod
+    def from_hf(cls, hf) -> "OwlViTConfig":
+        return cls(
+            text=OwlViTTextConfig.from_hf(hf.text_config),
+            vision=OwlViTVisionConfig.from_hf(hf.vision_config),
+            projection_dim=hf.projection_dim,
         )
 
 

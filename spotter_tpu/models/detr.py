@@ -1,0 +1,203 @@
+"""Flax DETR (facebook/detr-resnet-*): CNN backbone + vanilla encoder-decoder.
+
+Semantics match HF's DetrForObjectDetection (modeling_detr.py): frozen-BN
+ResNet backbone, mask-aware sine position embeddings (cumsum over the pixel
+mask, DetrSinePositionEmbedding), post-norm transformer layers where position
+embeddings are added to queries/keys only, zero-initialized object queries
+with learned query position embeddings, final decoder layernorm, linear class
+head (num_labels + 1 with "no object") and a 3-layer MLP box head with sigmoid.
+
+TPU-first notes: NHWC throughout; the pixel mask arrives as a static-shape
+(B, H, W) float array from the preprocess bucket (SURVEY.md §5.7), so the only
+data-dependent values are mask contents — shapes never change and XLA compiles
+one program per bucket. The reference serves this family through the same
+`AutoModelForObjectDetection` boundary (serve.py:199-205).
+"""
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from spotter_tpu.models.configs import DetrConfig
+from spotter_tpu.models.layers import MLPHead, MultiHeadAttention, get_activation
+from spotter_tpu.models.resnet import ResNetBackbone
+
+
+def nearest_downsample_mask(mask: jnp.ndarray, out_hw: tuple[int, int]) -> jnp.ndarray:
+    """torch F.interpolate(mode="nearest") on a (B, H, W) mask — static indices.
+
+    torch's legacy nearest uses src = floor(dst * in/out); the index tables are
+    computed in numpy from static shapes so XLA sees constant gathers.
+    """
+    _, h_in, w_in = mask.shape
+    h_out, w_out = out_hw
+    idx_h = np.floor(np.arange(h_out) * (h_in / h_out)).astype(np.int32)
+    idx_w = np.floor(np.arange(w_out) * (w_in / w_out)).astype(np.int32)
+    return mask[:, idx_h][:, :, idx_w]
+
+
+def sine_position_from_mask(
+    mask: jnp.ndarray, embed_dim: int, temperature: float = 10000.0
+) -> jnp.ndarray:
+    """DetrSinePositionEmbedding(normalize=True): (B, h, w) mask -> (B, h, w, 2*half).
+
+    Cumulative (1-based) row/col coordinates over valid pixels, normalized to
+    [0, 2*pi], interleaved sin/cos per coordinate; y-half then x-half.
+    """
+    half = embed_dim
+    scale = 2.0 * math.pi
+    y = jnp.cumsum(mask, axis=1)
+    x = jnp.cumsum(mask, axis=2)
+    y = y / (y[:, -1:, :] + 1e-6) * scale
+    x = x / (x[:, :, -1:] + 1e-6) * scale
+    dim_t = temperature ** (2.0 * (np.arange(half, dtype=np.float32) // 2) / half)
+    pos_x = x[..., None] / dim_t
+    pos_y = y[..., None] / dim_t
+
+    def interleave(p):
+        return jnp.stack([jnp.sin(p[..., 0::2]), jnp.cos(p[..., 1::2])], axis=-1).reshape(
+            *p.shape[:-1], -1
+        )
+
+    return jnp.concatenate([interleave(pos_y), interleave(pos_x)], axis=-1)
+
+
+class DetrEncoderLayer(nn.Module):
+    """Post-norm encoder layer (DetrEncoderLayer): self-attn + FFN."""
+
+    config: DetrConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, hidden: jnp.ndarray, pos: jnp.ndarray, attn_mask: Optional[jnp.ndarray]
+    ) -> jnp.ndarray:
+        cfg = self.config
+        attn = MultiHeadAttention(
+            cfg.d_model, cfg.encoder_attention_heads, dtype=self.dtype, name="self_attn"
+        )(hidden, position_embeddings=pos, attention_mask=attn_mask)
+        hidden = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="self_attn_layer_norm"
+        )(hidden + attn)
+        ffn = nn.Dense(cfg.encoder_ffn_dim, dtype=self.dtype, name="fc1")(hidden)
+        ffn = get_activation(cfg.activation_function)(ffn)
+        ffn = nn.Dense(cfg.d_model, dtype=self.dtype, name="fc2")(ffn)
+        return nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm"
+        )(hidden + ffn)
+
+
+class DetrDecoderLayer(nn.Module):
+    """Post-norm decoder layer: self-attn over queries + cross-attn to memory."""
+
+    config: DetrConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        queries: jnp.ndarray,
+        query_pos: jnp.ndarray,
+        memory: jnp.ndarray,
+        memory_pos: jnp.ndarray,
+        memory_mask: Optional[jnp.ndarray],
+    ) -> jnp.ndarray:
+        cfg = self.config
+        attn = MultiHeadAttention(
+            cfg.d_model, cfg.decoder_attention_heads, dtype=self.dtype, name="self_attn"
+        )(queries, position_embeddings=query_pos)
+        queries = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="self_attn_layer_norm"
+        )(queries + attn)
+        cross = MultiHeadAttention(
+            cfg.d_model, cfg.decoder_attention_heads, dtype=self.dtype, name="encoder_attn"
+        )(
+            queries,
+            position_embeddings=query_pos,
+            key_value_states=memory,
+            key_position_embeddings=memory_pos,
+            attention_mask=memory_mask,
+        )
+        queries = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="encoder_attn_layer_norm"
+        )(queries + cross)
+        ffn = nn.Dense(cfg.decoder_ffn_dim, dtype=self.dtype, name="fc1")(queries)
+        ffn = get_activation(cfg.activation_function)(ffn)
+        ffn = nn.Dense(cfg.d_model, dtype=self.dtype, name="fc2")(ffn)
+        return nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm"
+        )(queries + ffn)
+
+
+class DetrDetector(nn.Module):
+    """DETR object detector: returns {"logits": (B, Q, C+1), "pred_boxes": (B, Q, 4)}."""
+
+    config: DetrConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, pixel_values: jnp.ndarray, pixel_mask: Optional[jnp.ndarray] = None
+    ) -> dict[str, jnp.ndarray]:
+        cfg = self.config
+        b, h, w, _ = pixel_values.shape
+        if pixel_mask is None:
+            pixel_mask = jnp.ones((b, h, w), dtype=jnp.float32)
+
+        features = ResNetBackbone(cfg.backbone, dtype=self.dtype, name="backbone")(
+            pixel_values
+        )
+        feat = features[-1]
+        _, fh, fw, _ = feat.shape
+        mask = nearest_downsample_mask(pixel_mask, (fh, fw))
+
+        pos = sine_position_from_mask(
+            mask, cfg.d_model // 2, cfg.positional_encoding_temperature
+        ).astype(self.dtype)
+
+        proj = nn.Conv(
+            cfg.d_model, (1, 1), use_bias=True, dtype=self.dtype, name="input_projection"
+        )(feat)
+
+        src = proj.reshape(b, fh * fw, cfg.d_model)
+        pos = pos.reshape(b, fh * fw, cfg.d_model)
+        mask_flat = mask.reshape(b, fh * fw)
+        # additive mask, (B, 1, 1, S): valid -> 0, pad -> dtype-min (HF
+        # _prepare_4d_attention_mask semantics)
+        attn_mask = jnp.where(
+            mask_flat[:, None, None, :] > 0, 0.0, jnp.finfo(jnp.float32).min
+        )
+
+        for i in range(cfg.encoder_layers):
+            src = DetrEncoderLayer(cfg, dtype=self.dtype, name=f"encoder_layer{i}")(
+                src, pos, attn_mask
+            )
+
+        query_pos = self.param(
+            "query_pos",
+            nn.initializers.normal(1.0),
+            (cfg.num_queries, cfg.d_model),
+            jnp.float32,
+        )
+        query_pos = jnp.broadcast_to(
+            query_pos[None].astype(self.dtype), (b, cfg.num_queries, cfg.d_model)
+        )
+        queries = jnp.zeros_like(query_pos)
+        for i in range(cfg.decoder_layers):
+            queries = DetrDecoderLayer(cfg, dtype=self.dtype, name=f"decoder_layer{i}")(
+                queries, query_pos, src, pos, attn_mask
+            )
+        queries = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="decoder_layernorm"
+        )(queries)
+
+        logits = nn.Dense(
+            cfg.num_labels + 1, dtype=self.dtype, name="class_labels_classifier"
+        )(queries)
+        boxes = nn.sigmoid(
+            MLPHead(cfg.d_model, 4, 3, dtype=self.dtype, name="bbox_predictor")(queries)
+        )
+        return {"logits": logits, "pred_boxes": boxes}

@@ -1,8 +1,11 @@
-"""Flax ResNet-D backbone (the RT-DETR "presnet" variant).
+"""Flax ResNet backbones: "d" (RT-DETR presnet) and "v1" (classic / DETR).
 
-Semantics match HF's RTDetrResNetBackbone (modeling_rt_detr_resnet.py): deep
+style "d" matches HF's RTDetrResNetBackbone (modeling_rt_detr_resnet.py): deep
 3-conv stem, max-pool, and — the "D" trick — 2x2 ceil-mode average pooling in
-front of 1x1 projection shortcuts when downsampling. NHWC layout, frozen BN.
+front of 1x1 projection shortcuts when downsampling. style "v1" matches HF's
+ResNetBackbone / timm resnet (modeling_resnet.py): single 7x7 stride-2 stem and
+strided 1x1 projection shortcuts — the backbone of facebook/detr-resnet-*.
+NHWC layout, frozen BN.
 """
 
 from typing import Optional
@@ -96,6 +99,12 @@ def _basic_shortcut(in_ch: int, out_ch: int, stride: int, apply: bool) -> str:
     return "proj" if apply else "none"
 
 
+def _v1_shortcut(in_ch: int, out_ch: int, stride: int) -> str:
+    # modeling_resnet.py ResNet{Basic,BottleNeck}Layer: strided 1x1 projection
+    # whenever shape or stride changes, no avg-pool trick
+    return "proj" if (in_ch != out_ch or stride != 1) else "none"
+
+
 def _bottleneck_shortcut(in_ch: int, out_ch: int, stride: int) -> str:
     # RTDetrResNetBottleNeckLayer.__init__: stride==2 always takes the avg-pool
     # path (projection only when shapes change); stride==1 projects iff needed.
@@ -117,10 +126,14 @@ class ResNetBackbone(nn.Module):
         cfg = self.config
         act = cfg.hidden_act
         x = pixel_values.astype(self.dtype)
-        # Deep stem: 3x3 s2 -> 3x3 -> 3x3, then 3x3 s2 max pool.
-        x = ConvNorm(cfg.embedding_size // 2, 3, 2, activation=act, dtype=self.dtype, name="stem0")(x)
-        x = ConvNorm(cfg.embedding_size // 2, 3, 1, activation=act, dtype=self.dtype, name="stem1")(x)
-        x = ConvNorm(cfg.embedding_size, 3, 1, activation=act, dtype=self.dtype, name="stem2")(x)
+        if cfg.style == "v1":
+            # Classic stem: single 7x7 s2 conv, then 3x3 s2 max pool.
+            x = ConvNorm(cfg.embedding_size, 7, 2, activation=act, dtype=self.dtype, name="stem0")(x)
+        else:
+            # Deep stem: 3x3 s2 -> 3x3 -> 3x3.
+            x = ConvNorm(cfg.embedding_size // 2, 3, 2, activation=act, dtype=self.dtype, name="stem0")(x)
+            x = ConvNorm(cfg.embedding_size // 2, 3, 1, activation=act, dtype=self.dtype, name="stem1")(x)
+            x = ConvNorm(cfg.embedding_size, 3, 1, activation=act, dtype=self.dtype, name="stem2")(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
 
         hidden_states = [x]
@@ -132,17 +145,25 @@ class ResNetBackbone(nn.Module):
                 block_in = in_ch if block_idx == 0 else out_ch
                 name = f"stage{stage_idx}_block{block_idx}"
                 if cfg.layer_type == "bottleneck":
-                    shortcut = (
-                        _bottleneck_shortcut(block_in, out_ch, block_stride)
-                        if block_idx == 0
-                        else "none"
-                    )
+                    if block_idx != 0:
+                        shortcut = "none"
+                    elif cfg.style == "v1":
+                        shortcut = _v1_shortcut(block_in, out_ch, block_stride)
+                    else:
+                        shortcut = _bottleneck_shortcut(block_in, out_ch, block_stride)
                     x = BottleneckBlock(
                         out_ch, block_stride, shortcut, cfg.downsample_in_bottleneck,
                         act, self.dtype, name=name,
                     )(x)
                 else:
-                    shortcut = _basic_shortcut(block_in, out_ch, block_stride, block_idx == 0)
+                    if cfg.style == "v1":
+                        shortcut = (
+                            _v1_shortcut(block_in, out_ch, block_stride)
+                            if block_idx == 0
+                            else "none"
+                        )
+                    else:
+                        shortcut = _basic_shortcut(block_in, out_ch, block_stride, block_idx == 0)
                     x = BasicBlock(out_ch, block_stride, shortcut, act, self.dtype, name=name)(x)
             hidden_states.append(x)
             in_ch = out_ch

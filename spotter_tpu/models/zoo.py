@@ -20,10 +20,22 @@ import numpy as np
 
 from spotter_tpu.engine.engine import BuiltDetector
 from spotter_tpu.models.coco import coco_id2label_80
-from spotter_tpu.models.configs import RESNET_PRESETS, ResNetConfig, RTDetrConfig
+from spotter_tpu.models.configs import (
+    RESNET_PRESETS,
+    DetrConfig,
+    ResNetConfig,
+    RTDetrConfig,
+)
+from spotter_tpu.models.detr import DetrDetector
 from spotter_tpu.models.registry import ModelFamily, register
 from spotter_tpu.models.rtdetr import RTDetrDetector
-from spotter_tpu.ops.preprocess import RTDETR_SPEC, PreprocessSpec
+from spotter_tpu.ops.preprocess import (
+    DETR_SPEC,
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    RTDETR_SPEC,
+    PreprocessSpec,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -81,6 +93,57 @@ def _build_rtdetr(model_name: str) -> BuiltDetector:
     )
 
 
+def tiny_detr_config(num_labels: int = 80) -> DetrConfig:
+    return DetrConfig(
+        backbone=ResNetConfig(
+            embedding_size=8, hidden_sizes=(8, 12, 16, 24), depths=(1, 1, 1, 1),
+            layer_type="basic", style="v1", out_indices=(4,),
+        ),
+        num_labels=num_labels,
+        d_model=32,
+        num_queries=9,
+        encoder_layers=1,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=48,
+        decoder_ffn_dim=48,
+        id2label=tuple(coco_id2label_80().items()),
+    )
+
+
+def _build_detr(model_name: str) -> BuiltDetector:
+    if os.environ.get(TINY_ENV):
+        cfg = tiny_detr_config()
+        spec = PreprocessSpec(
+            mode="shortest_edge", size=(48, 64), mean=IMAGENET_MEAN, std=IMAGENET_STD,
+            pad_to=(64, 64),
+        )
+        module = DetrDetector(cfg)
+        params = _init_random(module, spec.input_hw)
+        logger.info("Built tiny random DETR for %s (%s)", model_name, TINY_ENV)
+    else:
+        from spotter_tpu.convert.loader import load_detr_from_hf  # lazy: needs torch
+
+        cfg, params = load_detr_from_hf(model_name)
+        spec = DETR_SPEC
+        module = DetrDetector(cfg)
+    return BuiltDetector(
+        model_name=model_name,
+        module=module,
+        params=params,
+        preprocess_spec=spec,
+        postprocess="softmax",
+        id2label=cfg.id2label_dict,
+        num_top_queries=cfg.num_queries,
+        needs_mask=True,
+    )
+
+
 register(
     ModelFamily(name="rtdetr", matches=("rtdetr", "rt_detr", "rt-detr"), build=_build_rtdetr)
+)
+register(
+    # plain DETR; matched AFTER rtdetr so "rtdetr*" names never land here
+    ModelFamily(name="detr", matches=("detr-resnet", "detr_resnet"), build=_build_detr)
 )

@@ -1,0 +1,81 @@
+"""Numerical parity: Flax DetrDetector vs HF torch DetrForObjectDetection.
+
+Tiny random-init config, no network — the same guarantee pattern as
+test_rtdetr_parity.py, including the padded-pixel-mask path (the reference's
+DETR processor pads batches; serve.py:98 relies on the processor mask).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import DetrConfig as HFDetrConfig
+from transformers import ResNetConfig as HFResNetConfig
+from transformers.models.detr.modeling_detr import DetrForObjectDetection
+
+from spotter_tpu.convert.detr_rules import detr_rules
+from spotter_tpu.convert.torch_to_jax import convert_state_dict
+from spotter_tpu.models.configs import DetrConfig
+from spotter_tpu.models.detr import DetrDetector
+
+
+def _tiny_hf_config(layer_type="basic"):
+    backbone = HFResNetConfig(
+        embedding_size=8,
+        hidden_sizes=[8, 12, 16, 24],
+        depths=[1, 1, 1, 1],
+        layer_type=layer_type,
+        out_features=["stage4"],
+    )
+    return HFDetrConfig(
+        use_timm_backbone=False,
+        use_pretrained_backbone=False,
+        backbone_config=backbone,
+        d_model=32,
+        encoder_layers=2,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=48,
+        decoder_ffn_dim=48,
+        num_queries=9,
+        num_labels=7,
+    )
+
+
+@pytest.mark.parametrize("layer_type", ["basic", "bottleneck"])
+def test_detr_parity(layer_type):
+    hf_cfg = _tiny_hf_config(layer_type)
+    torch.manual_seed(0)
+    model = DetrForObjectDetection(hf_cfg).eval()
+    with torch.no_grad():
+        for m in model.modules():
+            if hasattr(m, "running_mean"):
+                m.running_mean.uniform_(-0.2, 0.2)
+                m.running_var.uniform_(0.8, 1.2)
+
+    cfg = DetrConfig.from_hf(hf_cfg)
+    params = convert_state_dict(model.state_dict(), detr_rules(cfg), strict=True)
+
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, size=(2, 3, 64, 96)).astype(np.float32)
+    # ragged valid regions exercise the mask-aware position embedding + padding
+    mask = np.zeros((2, 64, 96), dtype=np.int64)
+    mask[0, :64, :80] = 1
+    mask[1, :48, :96] = 1
+
+    with torch.no_grad():
+        tout = model(torch.from_numpy(x), pixel_mask=torch.from_numpy(mask))
+
+    jout = DetrDetector(cfg).apply(
+        {"params": params},
+        np.transpose(x, (0, 2, 3, 1)),
+        mask.astype(np.float32),
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(jout["pred_boxes"]), tout.pred_boxes.numpy(), atol=2e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(jout["logits"]), tout.logits.numpy(), atol=5e-4, rtol=1e-3
+    )

@@ -66,3 +66,14 @@ def test_threshold_filters(engine):
     high = InferenceEngine(engine.built, threshold=0.99, batch_buckets=(1,))
     results = high.detect(_imgs(1))
     assert results == [[]]
+
+
+def test_detr_family_end_to_end():
+    """Tiny DETR through the full engine path (shortest-edge + mask + softmax)."""
+    built = build_detector("facebook/detr-resnet-50")
+    assert built.postprocess == "softmax" and built.needs_mask
+    eng = InferenceEngine(built, threshold=0.0, batch_buckets=(1, 2))
+    results = eng.detect(_imgs(3, hw=(40, 72)))
+    assert len(results) == 3
+    for dets in results:
+        assert all(set(d) == {"label", "score", "box"} for d in dets)
