@@ -9,6 +9,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <sstream>
 
@@ -46,6 +47,7 @@ const char* StatusText(int code) {
     case 400: return "Bad Request";
     case 404: return "Not Found";
     case 405: return "Method Not Allowed";
+    case 413: return "Content Too Large";
     case 500: return "Internal Server Error";
     case 502: return "Bad Gateway";
     default: return "";
@@ -120,19 +122,41 @@ bool HttpServer::Listen(const std::string& host, int port) {
   return true;
 }
 
+void HttpServer::WorkerLoop() {
+  while (true) {
+    int fd;
+    {
+      std::unique_lock<std::mutex> lk(queue_mu_);
+      queue_cv_.wait(lk, [&] { return stopping_.load() || !conn_queue_.empty(); });
+      if (conn_queue_.empty()) return;  // stopping and fully drained
+      fd = conn_queue_.front();
+      conn_queue_.pop_front();
+    }
+    HandleConn(fd);
+  }
+}
+
+void HttpServer::StartPool() {
+  if (!pool_.empty()) return;
+  unsigned n = std::thread::hardware_concurrency();
+  unsigned size = n == 0 ? 4 : std::min(n * 2, 16u);
+  for (unsigned i = 0; i < size; ++i)
+    pool_.emplace_back([this] { WorkerLoop(); });
+}
+
 void HttpServer::Serve() {
+  StartPool();
   while (!stopping_.load()) {
     pollfd pfd{listen_fd_, POLLIN, 0};
     int r = poll(&pfd, 1, 200);  // wake periodically to observe stopping_
     if (r <= 0) continue;
     int fd = accept(listen_fd_, nullptr, nullptr);
     if (fd < 0) continue;
-    in_flight_.fetch_add(1);
-    std::lock_guard<std::mutex> lock(workers_mu_);
-    workers_.emplace_back([this, fd] {
-      HandleConn(fd);
-      in_flight_.fetch_sub(1);
-    });
+    {
+      std::lock_guard<std::mutex> lock(queue_mu_);
+      conn_queue_.push_back(fd);
+    }
+    queue_cv_.notify_one();
   }
 }
 
@@ -147,15 +171,15 @@ void HttpServer::Shutdown() {
     close(listen_fd_);
     listen_fd_ = -1;
   }
-  // Graceful drain (reference: 5 s shutdown context, main.go:51-55). Joining
-  // rather than detaching: a handler thread that outlived a timed wait would
-  // use the freed server object. Worst case is bounded by the handlers' own
-  // socket timeouts; in-cluster, kubelet's grace period caps it anyway.
-  std::lock_guard<std::mutex> lock(workers_mu_);
-  for (auto& t : workers_) {
+  // Graceful drain (reference: 5 s shutdown context, main.go:51-55): workers
+  // finish queued connections, then observe stopping_ and exit; joining keeps
+  // every handler inside the server's lifetime. Worst case is bounded by the
+  // handlers' own socket timeouts; kubelet's grace period caps it in-cluster.
+  queue_cv_.notify_all();
+  for (auto& t : pool_) {
     if (t.joinable()) t.join();
   }
-  workers_.clear();
+  pool_.clear();
 }
 
 void HttpServer::HandleConn(int fd) {
@@ -193,6 +217,16 @@ void HttpServer::HandleConn(int fd) {
   size_t content_length = 0;
   auto it = req.headers.find("content-length");
   if (it != req.headers.end()) content_length = strtoul(it->second.c_str(), nullptr, 10);
+  // 64 MiB body cap (matches the serving app's client_max_size): without it a
+  // single unauthenticated request could balloon req.body past the pod limit
+  constexpr size_t kMaxBodyBytes = 64u << 20;
+  if (content_length > kMaxBodyBytes) {
+    SendAll(fd,
+            "HTTP/1.1 413 Content Too Large\r\nContent-Length: 0\r\n"
+            "Connection: close\r\n\r\n");
+    close(fd);
+    return;
+  }
   req.body = raw.substr(header_end);
   while (req.body.size() < content_length) {
     char buf[8192];

@@ -9,6 +9,8 @@
 #pragma once
 
 #include <atomic>
+#include <condition_variable>
+#include <deque>
 #include <functional>
 #include <map>
 #include <mutex>
@@ -54,14 +56,20 @@ class HttpServer {
 
  private:
   void HandleConn(int fd);
+  void WorkerLoop();
+  void StartPool();
   std::map<std::string, Handler> routes_;
   int listen_fd_ = -1;
   int port_ = 0;
   std::atomic<bool> stopping_{false};
-  std::atomic<int> in_flight_{0};
   std::thread accept_thread_;
-  std::mutex workers_mu_;
-  std::vector<std::thread> workers_;
+  // Fixed worker pool fed by an fd queue: bounds thread/memory use under
+  // sustained traffic (a thread-per-connection vector would grow forever)
+  // and gives Shutdown a clean drain point.
+  std::mutex queue_mu_;
+  std::condition_variable queue_cv_;
+  std::deque<int> conn_queue_;
+  std::vector<std::thread> pool_;
 };
 
 // ---- client ----

@@ -6,8 +6,11 @@ from_pretrained at serve.py:203): `spotter-tpu-download` pre-converts at image
 build; pod start loads the converted Orbax checkpoint directly.
 """
 
+import dataclasses
+import json
 import logging
 import os
+import typing
 from pathlib import Path
 
 import numpy as np
@@ -18,9 +21,35 @@ logger = logging.getLogger(__name__)
 
 CACHE_ENV = "SPOTTER_TPU_CACHE"
 DEFAULT_CACHE = "~/.cache/spotter_tpu"
-# Bump when conversion rules change: the cache key must invalidate old
-# conversions, or a fixed rule table would keep serving stale params forever.
-CACHE_VERSION = "v2"
+# Bump when conversion rules or the cache layout change: the cache key must
+# invalidate old conversions, or a fixed rule table would keep serving stale
+# params forever.
+CACHE_VERSION = "v3"
+
+
+def _tuplify(v):
+    return tuple(_tuplify(x) for x in v) if isinstance(v, list) else v
+
+
+def config_from_dict(cls, data: dict):
+    """Rebuild a (possibly nested) frozen config dataclass from JSON data.
+
+    JSON round-trips tuples as lists; config fields are tuples (hashability
+    under jit), so sequences are re-tuplified and nested dataclasses recursed.
+    """
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        value = data[f.name]
+        hint = hints.get(f.name)
+        if dataclasses.is_dataclass(hint) and isinstance(value, dict):
+            value = config_from_dict(hint, value)
+        elif isinstance(value, list):
+            value = _tuplify(value)
+        kwargs[f.name] = value
+    return cls(**kwargs)
 
 
 def cache_dir() -> Path:
@@ -31,79 +60,83 @@ def _cache_path(model_name: str) -> Path:
     return cache_dir() / f"{model_name.replace('/', '--')}--{CACHE_VERSION}"
 
 
-def _save_cache(path: Path, params: dict) -> None:
+def _save_cache(path: Path, cfg, params: dict) -> None:
     try:
         import orbax.checkpoint as ocp
 
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(path.absolute() / "params", params, force=True)
         ckptr.wait_until_finished()
+        # Config is written LAST: its presence marks the cache entry complete,
+        # and it is what lets the runtime load path skip torch+transformers
+        # entirely (the serving image uninstalls them after baking).
+        (path / "config.json").write_text(json.dumps(dataclasses.asdict(cfg)))
     except Exception:  # cache is best-effort; serving works without it
         logger.exception("Failed to write param cache at %s", path)
 
 
-def _load_cache(path: Path):
-    if not (path / "params").exists():
+def _load_cache(path: Path, config_cls):
+    if not ((path / "params").exists() and (path / "config.json").exists()):
         return None
     try:
         import orbax.checkpoint as ocp
 
+        cfg = config_from_dict(config_cls, json.loads((path / "config.json").read_text()))
         ckptr = ocp.StandardCheckpointer()
-        return ckptr.restore(path.absolute() / "params")
+        return cfg, ckptr.restore(path.absolute() / "params")
     except Exception:
         logger.exception("Failed to read param cache at %s", path)
         return None
 
 
 def load_rtdetr_from_hf(model_name: str) -> tuple[RTDetrConfig, dict]:
-    """Load + convert an RT-DETR(v2) checkpoint; Orbax-cached per MODEL_NAME."""
-    from transformers import AutoConfig
+    """Load + convert an RT-DETR(v2) checkpoint; Orbax-cached per MODEL_NAME.
 
-    hf_cfg = AutoConfig.from_pretrained(model_name)
-    cfg = RTDetrConfig.from_hf(hf_cfg)
-
-    cached = _load_cache(_cache_path(model_name))
+    The cache (params + config.json) is consulted FIRST so the runtime path in
+    the baked serving image never imports torch/transformers (Dockerfile
+    uninstalls them after `spotter-tpu-download` converts the weights).
+    """
+    cached = _load_cache(_cache_path(model_name), RTDetrConfig)
     if cached is not None:
-        logger.info("Loaded converted params for %s from cache", model_name)
-        return cfg, cached
+        logger.info("Loaded converted config+params for %s from cache", model_name)
+        return cached
 
-    import torch  # local import: only needed for first-time conversion
-    from transformers import AutoModelForObjectDetection
+    # Cache miss: first-time conversion (build-time bake or developer machine).
+    import torch
+    from transformers import AutoConfig, AutoModelForObjectDetection
 
     from spotter_tpu.convert.rtdetr_rules import rtdetr_rules
     from spotter_tpu.convert.torch_to_jax import convert_state_dict
 
+    cfg = RTDetrConfig.from_hf(AutoConfig.from_pretrained(model_name))
     with torch.no_grad():
         model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
     # strict: a rule whose torch key is absent means the rule table and the
     # checkpoint disagree — caching such a partial tree would serve a broken
     # model silently on every later pod start.
     params = convert_state_dict(model.state_dict(), rtdetr_rules(cfg), strict=True)
-    _save_cache(_cache_path(model_name), params)
+    _save_cache(_cache_path(model_name), cfg, params)
     return cfg, params
 
 
 def load_detr_from_hf(model_name: str) -> tuple[DetrConfig, dict]:
     """Load + convert a DETR checkpoint (timm- or HF-backbone serialization)."""
-    from transformers import AutoConfig
-
-    hf_cfg = AutoConfig.from_pretrained(model_name)
-    cfg = DetrConfig.from_hf(hf_cfg)
-
-    cached = _load_cache(_cache_path(model_name))
+    cached = _load_cache(_cache_path(model_name), DetrConfig)
     if cached is not None:
-        logger.info("Loaded converted params for %s from cache", model_name)
-        return cfg, cached
+        logger.info("Loaded converted config+params for %s from cache", model_name)
+        return cached
 
     import torch
-    from transformers import AutoModelForObjectDetection
+    from transformers import AutoConfig, AutoModelForObjectDetection
 
     from spotter_tpu.convert.detr_rules import detr_rules
     from spotter_tpu.convert.torch_to_jax import convert_state_dict
 
+    hf_cfg = AutoConfig.from_pretrained(model_name)
+    cfg = DetrConfig.from_hf(hf_cfg)
     with torch.no_grad():
         model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
     naming = "timm" if hf_cfg.use_timm_backbone else "hf"
     params = convert_state_dict(model.state_dict(), detr_rules(cfg, naming), strict=True)
-    _save_cache(_cache_path(model_name), params)
+    _save_cache(_cache_path(model_name), cfg, params)
     return cfg, params

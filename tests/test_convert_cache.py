@@ -1,0 +1,47 @@
+"""Param-cache round-trip: the runtime load path must work with ONLY the
+cache (no torch/transformers), since the serving image uninstalls them after
+baking (Dockerfile)."""
+
+import numpy as np
+
+from spotter_tpu.convert import loader
+from spotter_tpu.models.configs import DetrConfig, RTDetrConfig
+
+
+def test_config_json_round_trip():
+    import dataclasses
+    import json
+
+    cfg = RTDetrConfig(id2label=((0, "tv"), (1, "couch")))
+    data = json.loads(json.dumps(dataclasses.asdict(cfg)))
+    back = loader.config_from_dict(RTDetrConfig, data)
+    assert back == cfg
+    assert hash(back) == hash(cfg)  # still a static-arg-compatible dataclass
+
+    dcfg = DetrConfig(id2label=((3, "car"),))
+    data = json.loads(json.dumps(dataclasses.asdict(dcfg)))
+    assert loader.config_from_dict(DetrConfig, data) == dcfg
+
+
+def test_cache_round_trip_without_transformers(tmp_path, monkeypatch):
+    monkeypatch.setenv(loader.CACHE_ENV, str(tmp_path))
+    cfg = DetrConfig(num_labels=5, id2label=((0, "tv"),))
+    params = {"backbone": {"stem0": {"conv": {"kernel": np.ones((3, 3, 3, 8), np.float32)}}}}
+    path = loader._cache_path("fake/model")
+    loader._save_cache(path, cfg, params)
+
+    got = loader._load_cache(path, DetrConfig)
+    assert got is not None
+    got_cfg, got_params = got
+    assert got_cfg == cfg
+    np.testing.assert_array_equal(
+        got_params["backbone"]["stem0"]["conv"]["kernel"],
+        params["backbone"]["stem0"]["conv"]["kernel"],
+    )
+
+
+def test_incomplete_cache_is_miss(tmp_path, monkeypatch):
+    monkeypatch.setenv(loader.CACHE_ENV, str(tmp_path))
+    path = loader._cache_path("fake/partial")
+    (path / "params").mkdir(parents=True)  # params dir without config.json
+    assert loader._load_cache(path, DetrConfig) is None
