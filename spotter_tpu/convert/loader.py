@@ -15,7 +15,7 @@ from pathlib import Path
 
 import numpy as np
 
-from spotter_tpu.models.configs import DetrConfig, RTDetrConfig
+from spotter_tpu.models.configs import DetrConfig, RTDetrConfig, YolosConfig
 
 logger = logging.getLogger(__name__)
 
@@ -138,5 +138,26 @@ def load_detr_from_hf(model_name: str) -> tuple[DetrConfig, dict]:
         model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
     naming = "timm" if hf_cfg.use_timm_backbone else "hf"
     params = convert_state_dict(model.state_dict(), detr_rules(cfg, naming), strict=True)
+    _save_cache(_cache_path(model_name), cfg, params)
+    return cfg, params
+
+
+def load_yolos_from_hf(model_name: str) -> tuple[YolosConfig, dict]:
+    """Load + convert a YOLOS checkpoint; Orbax-cached per MODEL_NAME."""
+    cached = _load_cache(_cache_path(model_name), YolosConfig)
+    if cached is not None:
+        logger.info("Loaded converted config+params for %s from cache", model_name)
+        return cached
+
+    import torch
+    from transformers import AutoConfig, AutoModelForObjectDetection
+
+    from spotter_tpu.convert.torch_to_jax import convert_state_dict
+    from spotter_tpu.convert.yolos_rules import yolos_rules
+
+    cfg = YolosConfig.from_hf(AutoConfig.from_pretrained(model_name))
+    with torch.no_grad():
+        model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
+    params = convert_state_dict(model.state_dict(), yolos_rules(cfg), strict=True)
     _save_cache(_cache_path(model_name), cfg, params)
     return cfg, params

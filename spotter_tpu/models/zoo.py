@@ -25,8 +25,10 @@ from spotter_tpu.models.configs import (
     DetrConfig,
     ResNetConfig,
     RTDetrConfig,
+    YolosConfig,
 )
 from spotter_tpu.models.detr import DetrDetector
+from spotter_tpu.models.yolos import YolosDetector
 from spotter_tpu.models.registry import ModelFamily, register
 from spotter_tpu.models.rtdetr import RTDetrDetector
 from spotter_tpu.ops.preprocess import (
@@ -140,9 +142,56 @@ def _build_detr(model_name: str) -> BuiltDetector:
     )
 
 
+def tiny_yolos_config(num_labels: int = 80) -> YolosConfig:
+    return YolosConfig(
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=48,
+        image_size=(32, 48),
+        patch_size=8,
+        num_detection_tokens=5,
+        num_labels=num_labels,
+        id2label=tuple(coco_id2label_80().items()),
+    )
+
+
+def _build_yolos(model_name: str) -> BuiltDetector:
+    if os.environ.get(TINY_ENV):
+        cfg = tiny_yolos_config()
+        module = YolosDetector(cfg)
+        spec = PreprocessSpec(
+            mode="fixed", size=cfg.image_size, mean=IMAGENET_MEAN, std=IMAGENET_STD
+        )
+        params = _init_random(module, spec.input_hw)
+        logger.info("Built tiny random YOLOS for %s (%s)", model_name, TINY_ENV)
+    else:
+        from spotter_tpu.convert.loader import load_yolos_from_hf  # lazy: needs torch
+
+        cfg, params = load_yolos_from_hf(model_name)
+        module = YolosDetector(cfg)
+        # Warp-resize to the trained image size: position tables apply exactly
+        # and every shape is static. (The torch processor instead pads to the
+        # batch max and interpolates position tables per size — a recompile
+        # per shape under XLA.)
+        spec = PreprocessSpec(
+            mode="fixed", size=cfg.image_size, mean=IMAGENET_MEAN, std=IMAGENET_STD
+        )
+    return BuiltDetector(
+        model_name=model_name,
+        module=module,
+        params=params,
+        preprocess_spec=spec,
+        postprocess="softmax",
+        id2label=cfg.id2label_dict,
+        num_top_queries=cfg.num_detection_tokens,
+    )
+
+
 register(
     ModelFamily(name="rtdetr", matches=("rtdetr", "rt_detr", "rt-detr"), build=_build_rtdetr)
 )
+register(ModelFamily(name="yolos", matches=("yolos",), build=_build_yolos))
 register(
     # plain DETR; matched AFTER rtdetr so "rtdetr*" names never land here
     ModelFamily(name="detr", matches=("detr-resnet", "detr_resnet"), build=_build_detr)

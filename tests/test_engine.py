@@ -77,3 +77,13 @@ def test_detr_family_end_to_end():
     assert len(results) == 3
     for dets in results:
         assert all(set(d) == {"label", "score", "box"} for d in dets)
+
+
+def test_yolos_family_end_to_end():
+    """Tiny YOLOS through the full engine path (fixed warp + softmax)."""
+    built = build_detector("hustvl/yolos-base")
+    assert built.postprocess == "softmax" and not built.needs_mask
+    eng = InferenceEngine(built, threshold=0.0, batch_buckets=(1, 2))
+    results = eng.detect(_imgs(2, hw=(50, 70)))
+    assert len(results) == 2
+    assert all(len(d) > 0 for d in results)

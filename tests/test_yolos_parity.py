@@ -1,0 +1,66 @@
+"""Numerical parity: Flax YolosDetector vs HF torch YolosForObjectDetection.
+
+Tiny random-init config at the native image size (the serving path always
+feeds the trained size, so position tables are exercised without
+interpolation), with and without mid position embeddings.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import YolosConfig as HFYolosConfig
+from transformers.models.yolos.modeling_yolos import YolosForObjectDetection
+
+from spotter_tpu.convert.torch_to_jax import convert_state_dict
+from spotter_tpu.convert.yolos_rules import yolos_rules
+from spotter_tpu.models.configs import YolosConfig
+from spotter_tpu.models.yolos import YolosDetector
+
+
+def _tiny_hf_config(use_mid):
+    return HFYolosConfig(
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=48,
+        image_size=[32, 48],
+        patch_size=8,
+        num_detection_tokens=5,
+        use_mid_position_embeddings=use_mid,
+        num_labels=7,
+    )
+
+
+@pytest.mark.parametrize("use_mid", [True, False])
+def test_yolos_parity(use_mid):
+    hf_cfg = _tiny_hf_config(use_mid)
+    torch.manual_seed(0)
+    model = YolosForObjectDetection(hf_cfg).eval()
+    # zeros-initialized tokens/tables would hide wiring bugs; randomize them
+    with torch.no_grad():
+        for p in (
+            model.vit.embeddings.cls_token,
+            model.vit.embeddings.detection_tokens,
+            model.vit.embeddings.position_embeddings,
+        ):
+            p.uniform_(-0.5, 0.5)
+        if use_mid:
+            model.vit.encoder.mid_position_embeddings.uniform_(-0.5, 0.5)
+
+    cfg = YolosConfig.from_hf(hf_cfg)
+    params = convert_state_dict(model.state_dict(), yolos_rules(cfg), strict=True)
+
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-1, 1, size=(2, 3, 32, 48)).astype(np.float32)
+    with torch.no_grad():
+        tout = model(torch.from_numpy(x))
+
+    jout = YolosDetector(cfg).apply({"params": params}, np.transpose(x, (0, 2, 3, 1)))
+
+    np.testing.assert_allclose(
+        np.asarray(jout["pred_boxes"]), tout.pred_boxes.numpy(), atol=2e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(jout["logits"]), tout.logits.numpy(), atol=5e-4, rtol=1e-3
+    )
