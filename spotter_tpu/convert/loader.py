@@ -15,7 +15,12 @@ from pathlib import Path
 
 import numpy as np
 
-from spotter_tpu.models.configs import DetrConfig, RTDetrConfig, YolosConfig
+from spotter_tpu.models.configs import (
+    DetrConfig,
+    OwlViTConfig,
+    RTDetrConfig,
+    YolosConfig,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -140,6 +145,67 @@ def load_detr_from_hf(model_name: str) -> tuple[DetrConfig, dict]:
     params = convert_state_dict(model.state_dict(), detr_rules(cfg, naming), strict=True)
     _save_cache(_cache_path(model_name), cfg, params)
     return cfg, params
+
+
+def load_owlvit_from_hf(model_name: str) -> tuple[OwlViTConfig, dict]:
+    """Load + convert an OWL-ViT checkpoint; Orbax-cached per MODEL_NAME."""
+    cached = _load_cache(_cache_path(model_name), OwlViTConfig)
+    if cached is not None:
+        logger.info("Loaded converted config+params for %s from cache", model_name)
+        return cached
+
+    import torch
+    from transformers import AutoConfig
+    from transformers.models.owlvit.modeling_owlvit import OwlViTForObjectDetection
+
+    from spotter_tpu.convert.owlvit_rules import owlvit_rules
+    from spotter_tpu.convert.torch_to_jax import convert_state_dict
+
+    cfg = OwlViTConfig.from_hf(AutoConfig.from_pretrained(model_name))
+    with torch.no_grad():
+        model = OwlViTForObjectDetection.from_pretrained(model_name).eval()
+    # The rule table maps the detection path only (contrastive-only weights —
+    # visual_projection, logit_scale — are deliberately unmapped); strict still
+    # requires every mapped torch key to exist in the checkpoint.
+    params = convert_state_dict(model.state_dict(), owlvit_rules(cfg), strict=True)
+    _save_cache(_cache_path(model_name), cfg, params)
+    return cfg, params
+
+
+def owlvit_tokenize(
+    model_name: str, prompts: list[str], max_length: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Tokenize text queries, cached per MODEL_NAME alongside the param cache.
+
+    The cache file makes the runtime path tokenizer-free: queries seen at bake
+    time (the default taxonomy — download.py runs build_detector) resolve from
+    JSON; only novel runtime queries import transformers.
+    """
+    path = _cache_path(model_name) / "tokenized.json"
+    table: dict[str, dict] = {}
+    if path.exists():
+        try:
+            table = json.loads(path.read_text())
+        except Exception:
+            logger.exception("Failed to read tokenization cache at %s", path)
+    missing = [p for p in prompts if p not in table]
+    if missing:
+        from transformers import AutoTokenizer  # lazy: bake/dev machines only
+
+        tok = AutoTokenizer.from_pretrained(model_name)
+        enc = tok(
+            missing, padding="max_length", max_length=max_length, truncation=True
+        )
+        for p, ids, mask in zip(missing, enc["input_ids"], enc["attention_mask"]):
+            table[p] = {"ids": ids, "mask": mask}
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(json.dumps(table))
+        except Exception:
+            logger.exception("Failed to write tokenization cache at %s", path)
+    ids = np.asarray([table[p]["ids"] for p in prompts], dtype=np.int32)
+    mask = np.asarray([table[p]["mask"] for p in prompts], dtype=np.int32)
+    return ids, mask
 
 
 def load_yolos_from_hf(model_name: str) -> tuple[YolosConfig, dict]:

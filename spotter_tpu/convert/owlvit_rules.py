@@ -1,0 +1,58 @@
+"""torch->Flax conversion rules for OWL-ViT (google/owlvit-*).
+
+torch layout (modeling_owlvit.py, OwlViTForObjectDetection): CLIP towers under
+owlvit.text_model.* / owlvit.vision_model.*, the text projection at
+owlvit.text_projection, the detection merge LayerNorm at the top-level
+`layer_norm`, and class_head / box_head prediction heads. The contrastive-only
+pieces (visual_projection, logit_scale) are not part of the detection path and
+are deliberately unmapped.
+"""
+
+from spotter_tpu.convert.torch_to_jax import Rules
+from spotter_tpu.models.configs import OwlViTConfig
+
+
+def _tower_layers(r: Rules, flax_root: tuple, torch_root: str, num_layers: int) -> None:
+    for i in range(num_layers):
+        f = (*flax_root, f"layer{i}")
+        t = f"{torch_root}.encoder.layers.{i}"
+        r.layernorm((*f, "layer_norm1"), f"{t}.layer_norm1")
+        r.attention((*f, "self_attn"), f"{t}.self_attn")
+        r.layernorm((*f, "layer_norm2"), f"{t}.layer_norm2")
+        r.dense((*f, "fc1"), f"{t}.mlp.fc1")
+        r.dense((*f, "fc2"), f"{t}.mlp.fc2")
+
+
+def owlvit_rules(cfg: OwlViTConfig) -> Rules:
+    r = Rules()
+    # text tower
+    r.add(("text", "token_embedding"), "owlvit.text_model.embeddings.token_embedding.weight")
+    r.add(
+        ("text", "position_embedding"),
+        "owlvit.text_model.embeddings.position_embedding.weight",
+    )
+    _tower_layers(r, ("text",), "owlvit.text_model", cfg.text.num_hidden_layers)
+    r.layernorm(("text", "final_layer_norm"), "owlvit.text_model.final_layer_norm")
+    r.add(("text_projection", "kernel"), "owlvit.text_projection.weight", "dense")
+
+    # vision tower
+    r.add(("vision", "class_embedding"), "owlvit.vision_model.embeddings.class_embedding")
+    r.conv(
+        ("vision", "patch_embedding"),
+        "owlvit.vision_model.embeddings.patch_embedding.weight",
+    )
+    r.add(
+        ("vision", "position_embedding"),
+        "owlvit.vision_model.embeddings.position_embedding.weight",
+    )
+    r.layernorm(("vision", "pre_layernorm"), "owlvit.vision_model.pre_layernorm")
+    _tower_layers(r, ("vision",), "owlvit.vision_model", cfg.vision.num_hidden_layers)
+    r.layernorm(("vision", "post_layernorm"), "owlvit.vision_model.post_layernorm")
+
+    # detection heads
+    r.layernorm(("merge_layer_norm",), "layer_norm")
+    for name in ("dense0", "logit_shift", "logit_scale"):
+        r.dense(("class_head", name), f"class_head.{name}")
+    for name in ("dense0", "dense1", "dense2"):
+        r.dense(("box_head", name), f"box_head.{name}")
+    return r

@@ -1,0 +1,311 @@
+"""Flax OWL-ViT (google/owlvit-*): open-vocabulary detection, text-conditioned.
+
+Semantics match HF's OwlViTForObjectDetection (modeling_owlvit.py): CLIP-style
+vision and text towers, class-token merge over patch features, a text-query
+class head (normalized dot product with learned per-patch logit shift/scale)
+and a box MLP head biased toward each patch's grid position.
+
+TPU-first split (SURVEY.md §7): the queries a deployment serves are static
+(the amenity taxonomy, or an operator-supplied list), so `encode_text` runs
+ONCE at model-build time and its output rides along as a small constant —
+the serving hot path is vision-only, keeping the per-request program a pure
+(B, H, W, 3) -> fixed-shape detection map that XLA tiles onto the MXU. The
+reference serves detection through the same `MODEL_NAME` boundary
+(serve.py:199-205); open-vocab is the one family where the label set itself
+is a deploy-time input rather than checkpoint metadata.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from spotter_tpu.models.configs import (
+    OwlViTConfig,
+    OwlViTTextConfig,
+    OwlViTVisionConfig,
+)
+from spotter_tpu.models.layers import MultiHeadAttention, get_activation
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+
+def owlvit_box_bias(grid_h: int, grid_w: int) -> np.ndarray:
+    """Per-patch box prior, (grid_h*grid_w, 4) numpy — constant under jit.
+
+    Centers biased to the patch's normalized grid position, sizes to one patch
+    (both through an inverse sigmoid with the 1e-4 eps the checkpoints were
+    trained with). Row-major over (h, w), matching the patch-embedding flatten.
+    """
+    x = np.arange(1, grid_w + 1, dtype=np.float32) / grid_w
+    y = np.arange(1, grid_h + 1, dtype=np.float32) / grid_h
+    xx, yy = np.meshgrid(x, y)  # (grid_h, grid_w)
+    coords = np.stack([xx, yy], axis=-1).reshape(-1, 2)
+    coord_bias = np.log(coords + 1e-4) - np.log1p(-coords + 1e-4)
+    size = np.empty_like(coords)
+    size[:, 0] = 1.0 / grid_w
+    size[:, 1] = 1.0 / grid_h
+    size_bias = np.log(size + 1e-4) - np.log1p(-size + 1e-4)
+    return np.concatenate([coord_bias, size_bias], axis=-1).astype(np.float32)
+
+
+class OwlViTLayer(nn.Module):
+    """Pre-norm CLIP transformer block (ln1 -> attn -> res, ln2 -> mlp -> res)."""
+
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    hidden_act: str
+    layer_norm_eps: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, attention_mask: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        h = nn.LayerNorm(
+            epsilon=self.layer_norm_eps, dtype=self.dtype, name="layer_norm1"
+        )(x)
+        x = x + MultiHeadAttention(
+            self.hidden_size, self.num_heads, dtype=self.dtype, name="self_attn"
+        )(h, attention_mask=attention_mask)
+        h = nn.LayerNorm(
+            epsilon=self.layer_norm_eps, dtype=self.dtype, name="layer_norm2"
+        )(x)
+        h = nn.Dense(self.intermediate_size, dtype=self.dtype, name="fc1")(h)
+        h = get_activation(self.hidden_act)(h)
+        return x + nn.Dense(self.hidden_size, dtype=self.dtype, name="fc2")(h)
+
+
+class OwlViTTextTower(nn.Module):
+    """CLIP text transformer -> pooled EOT-token features, (Q, D_text).
+
+    Causal attention plus the padding mask; pooling picks the position of the
+    highest token id (CLIP's end-of-text token) per query.
+    """
+
+    config: OwlViTTextConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, input_ids: jnp.ndarray, attention_mask: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        cfg = self.config
+        q, t = input_ids.shape
+        tok_table = self.param(
+            "token_embedding",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.hidden_size),
+            jnp.float32,
+        )
+        pos_table = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.02),
+            (cfg.max_position_embeddings, cfg.hidden_size),
+            jnp.float32,
+        )
+        x = jnp.take(tok_table, input_ids, axis=0).astype(self.dtype)
+        x = x + pos_table[:t].astype(self.dtype)
+
+        causal = jnp.triu(jnp.full((t, t), NEG_INF, jnp.float32), k=1)
+        mask = causal[None, None]  # (1, 1, T, T)
+        if attention_mask is not None:
+            pad = jnp.where(attention_mask == 0, NEG_INF, 0.0).astype(jnp.float32)
+            mask = mask + pad[:, None, None, :]  # (Q, 1, T, T)
+
+        for i in range(cfg.num_hidden_layers):
+            x = OwlViTLayer(
+                cfg.hidden_size,
+                cfg.num_attention_heads,
+                cfg.intermediate_size,
+                cfg.hidden_act,
+                cfg.layer_norm_eps,
+                dtype=self.dtype,
+                name=f"layer{i}",
+            )(x, attention_mask=mask)
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm"
+        )(x)
+
+        eot = jnp.argmax(input_ids, axis=-1)  # first occurrence of the max id
+        return jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+
+
+class OwlViTVisionTower(nn.Module):
+    """CLIP vision transformer -> post-LN token sequence, (B, 1 + P, D_vision)."""
+
+    config: OwlViTVisionConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixel_values: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        p = cfg.patch_size
+        b, h, w, _ = pixel_values.shape
+        if h % p or w % p:
+            raise ValueError(f"input {h}x{w} not divisible by patch size {p}")
+        gh, gw = h // p, w // p
+
+        x = nn.Conv(
+            cfg.hidden_size,
+            (p, p),
+            strides=(p, p),
+            use_bias=False,
+            dtype=self.dtype,
+            name="patch_embedding",
+        )(pixel_values.astype(self.dtype))
+        x = x.reshape(b, gh * gw, cfg.hidden_size)
+
+        cls = self.param(
+            "class_embedding",
+            nn.initializers.normal(0.02),
+            (cfg.hidden_size,),
+            jnp.float32,
+        )
+        pos = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.02),
+            (cfg.grid * cfg.grid + 1, cfg.hidden_size),
+            jnp.float32,
+        )
+        patch_pos = pos[1:]
+        if (gh, gw) != (cfg.grid, cfg.grid):
+            # off-native static size: bicubic table interpolation at trace time
+            grid_tab = patch_pos.reshape(1, cfg.grid, cfg.grid, cfg.hidden_size)
+            grid_tab = jax.image.resize(
+                grid_tab, (1, gh, gw, cfg.hidden_size), method="bicubic"
+            )
+            patch_pos = grid_tab.reshape(gh * gw, cfg.hidden_size)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, cfg.hidden_size)), x],
+            axis=1,
+        )
+        x = x + jnp.concatenate([pos[:1], patch_pos], axis=0).astype(self.dtype)
+
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="pre_layernorm"
+        )(x)
+        for i in range(cfg.num_hidden_layers):
+            x = OwlViTLayer(
+                cfg.hidden_size,
+                cfg.num_attention_heads,
+                cfg.intermediate_size,
+                cfg.hidden_act,
+                cfg.layer_norm_eps,
+                dtype=self.dtype,
+                name=f"layer{i}",
+            )(x)
+        return nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="post_layernorm"
+        )(x)
+
+
+class OwlViTClassHead(nn.Module):
+    """Text-query classifier: cosine logits with learned per-patch shift/scale."""
+
+    config: OwlViTConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        image_feats: jnp.ndarray,  # (B, P, D_vision)
+        query_embeds: jnp.ndarray,  # (Q, D_text) — precomputed at build time
+        query_mask: Optional[jnp.ndarray] = None,  # (Q,) 1=valid
+    ) -> jnp.ndarray:
+        cfg = self.config
+        img_cls = nn.Dense(cfg.text.hidden_size, dtype=self.dtype, name="dense0")(
+            image_feats
+        )
+        img_cls = img_cls / (jnp.linalg.norm(img_cls, axis=-1, keepdims=True) + 1e-6)
+        q = query_embeds / (jnp.linalg.norm(query_embeds, axis=-1, keepdims=True) + 1e-6)
+        logits = jnp.einsum("bpd,qd->bpq", img_cls, q.astype(img_cls.dtype))
+
+        shift = nn.Dense(1, dtype=self.dtype, name="logit_shift")(image_feats)
+        scale = nn.Dense(1, dtype=self.dtype, name="logit_scale")(image_feats)
+        scale = jax.nn.elu(scale) + 1.0
+        logits = (logits + shift) * scale
+        if query_mask is not None:
+            logits = jnp.where(query_mask[None, None, :] == 0, NEG_INF, logits)
+        return logits
+
+
+class OwlViTBoxHead(nn.Module):
+    """Box MLP (dense-gelu-dense-gelu-dense) + static grid bias + sigmoid."""
+
+    config: OwlViTVisionConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, image_feats: jnp.ndarray, grid_hw: tuple[int, int]
+    ) -> jnp.ndarray:
+        d = self.config.hidden_size
+        x = nn.Dense(d, dtype=self.dtype, name="dense0")(image_feats)
+        x = nn.gelu(x, approximate=False)
+        x = nn.Dense(d, dtype=self.dtype, name="dense1")(x)
+        x = nn.gelu(x, approximate=False)
+        x = nn.Dense(4, dtype=self.dtype, name="dense2")(x)
+        bias = owlvit_box_bias(*grid_hw)  # numpy: XLA constant-folds it
+        return nn.sigmoid(x + jnp.asarray(bias, self.dtype))
+
+
+class OwlViTDetector(nn.Module):
+    """OWL-ViT detector.
+
+    `__call__(pixels, query_embeds)` is the serving forward:
+    {"logits": (B, P, Q), "pred_boxes": (B, P, 4) normalized cxcywh}.
+    `encode_text(input_ids, attention_mask)` -> normalized (Q, proj) query
+    embeddings, run once at build time. `detect_with_text` chains both (used
+    for init and parity testing).
+    """
+
+    config: OwlViTConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.vision = OwlViTVisionTower(cfg.vision, dtype=self.dtype)
+        self.text = OwlViTTextTower(cfg.text, dtype=self.dtype)
+        self.text_projection = nn.Dense(
+            cfg.projection_dim, use_bias=False, dtype=self.dtype
+        )
+        # the detection head's merge LayerNorm (HF: OwlViTForObjectDetection.layer_norm)
+        self.merge_layer_norm = nn.LayerNorm(
+            epsilon=cfg.vision.layer_norm_eps, dtype=self.dtype
+        )
+        self.class_head = OwlViTClassHead(cfg, dtype=self.dtype)
+        self.box_head = OwlViTBoxHead(cfg.vision, dtype=self.dtype)
+
+    def encode_text(
+        self, input_ids: jnp.ndarray, attention_mask: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        pooled = self.text(input_ids, attention_mask)
+        q = self.text_projection(pooled)
+        return q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+
+    def __call__(
+        self,
+        pixel_values: jnp.ndarray,
+        query_embeds: jnp.ndarray,
+        query_mask: Optional[jnp.ndarray] = None,
+    ) -> dict[str, jnp.ndarray]:
+        feats = self.vision(pixel_values)  # (B, 1+P, D)
+        image_feats = feats[:, 1:, :] * feats[:, :1, :]  # class-token merge
+        image_feats = self.merge_layer_norm(image_feats)
+        logits = self.class_head(image_feats, query_embeds, query_mask)
+        gh = pixel_values.shape[1] // self.config.vision.patch_size
+        gw = pixel_values.shape[2] // self.config.vision.patch_size
+        boxes = self.box_head(image_feats, (gh, gw))
+        return {"logits": logits, "pred_boxes": boxes}
+
+    def detect_with_text(
+        self,
+        pixel_values: jnp.ndarray,
+        input_ids: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray] = None,
+    ) -> dict[str, jnp.ndarray]:
+        return self(pixel_values, self.encode_text(input_ids, attention_mask))

@@ -23,18 +23,25 @@ from spotter_tpu.models.coco import coco_id2label_80
 from spotter_tpu.models.configs import (
     RESNET_PRESETS,
     DetrConfig,
+    OwlViTConfig,
+    OwlViTTextConfig,
+    OwlViTVisionConfig,
     ResNetConfig,
     RTDetrConfig,
     YolosConfig,
 )
 from spotter_tpu.models.detr import DetrDetector
+from spotter_tpu.models.owlvit import OwlViTDetector
 from spotter_tpu.models.yolos import YolosDetector
 from spotter_tpu.models.registry import ModelFamily, register
 from spotter_tpu.models.rtdetr import RTDetrDetector
 from spotter_tpu.ops.preprocess import (
+    CLIP_MEAN,
+    CLIP_STD,
     DETR_SPEC,
     IMAGENET_MEAN,
     IMAGENET_STD,
+    OWLVIT_SPEC,
     RTDETR_SPEC,
     PreprocessSpec,
 )
@@ -188,9 +195,94 @@ def _build_yolos(model_name: str) -> BuiltDetector:
     )
 
 
+def tiny_owlvit_config() -> OwlViTConfig:
+    return OwlViTConfig(
+        text=OwlViTTextConfig(
+            vocab_size=99, hidden_size=16, intermediate_size=24,
+            num_hidden_layers=2, num_attention_heads=2, max_position_embeddings=8,
+        ),
+        vision=OwlViTVisionConfig(
+            hidden_size=20, intermediate_size=28, num_hidden_layers=2,
+            num_attention_heads=2, image_size=32, patch_size=8,
+        ),
+        projection_dim=16,
+    )
+
+
+QUERIES_ENV = "SPOTTER_TPU_TEXT_QUERIES"
+
+
+def owlvit_query_labels() -> list[str]:
+    """Deploy-time label set for open-vocab detection.
+
+    Defaults to the amenity taxonomy's COCO labels (so the downstream
+    AMENITIES_MAPPING filter behaves exactly as with closed-set detectors);
+    operators override with a comma-separated SPOTTER_TPU_TEXT_QUERIES — the
+    capability the reference's fixed-vocab models cannot offer.
+    """
+    env = os.environ.get(QUERIES_ENV, "")
+    if env.strip():
+        labels = [s.strip() for s in env.split(",") if s.strip()]
+        if not labels:
+            raise ValueError(
+                f"{QUERIES_ENV} is set but contains no labels: {env!r}"
+            )
+        return labels
+    from spotter_tpu.taxonomy import AMENITIES_MAPPING
+
+    return list(AMENITIES_MAPPING)
+
+
+def _build_owlvit(model_name: str) -> BuiltDetector:
+    labels = owlvit_query_labels()
+    prompts = [f"a photo of a {label}" for label in labels]
+    if os.environ.get(TINY_ENV):
+        cfg = tiny_owlvit_config()
+        module = OwlViTDetector(cfg)
+        spec = PreprocessSpec(mode="fixed", size=(32, 32), mean=CLIP_MEAN, std=CLIP_STD)
+        rng = np.random.default_rng(0)
+        t = cfg.text.max_position_embeddings
+        ids = rng.integers(1, cfg.text.vocab_size, (len(prompts), t)).astype(np.int32)
+        mask = np.ones_like(ids)
+        params = module.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, 32, 32, 3), np.float32),
+            ids,
+            mask,
+            method=OwlViTDetector.detect_with_text,
+        )["params"]
+        logger.info("Built tiny random OWL-ViT for %s (%s)", model_name, TINY_ENV)
+    else:
+        from spotter_tpu.convert.loader import (  # lazy: needs torch first time
+            load_owlvit_from_hf,
+            owlvit_tokenize,
+        )
+
+        cfg, params = load_owlvit_from_hf(model_name)
+        module = OwlViTDetector(cfg)
+        spec = OWLVIT_SPEC
+        ids, mask = owlvit_tokenize(model_name, prompts, cfg.text.max_position_embeddings)
+    # TPU-first split: the text tower runs ONCE here; the serving hot path is
+    # vision-only with the (Q, proj) query matrix riding as a jit constant.
+    query_embeds = np.asarray(
+        module.apply({"params": params}, ids, mask, method=OwlViTDetector.encode_text)
+    )
+    return BuiltDetector(
+        model_name=model_name,
+        module=module,
+        params=params,
+        preprocess_spec=spec,
+        postprocess="sigmoid_max",
+        id2label=dict(enumerate(labels)),
+        num_top_queries=len(labels),
+        apply_kwargs={"query_embeds": query_embeds},
+    )
+
+
 register(
     ModelFamily(name="rtdetr", matches=("rtdetr", "rt_detr", "rt-detr"), build=_build_rtdetr)
 )
+register(ModelFamily(name="owlvit", matches=("owlvit", "owl-vit", "owl_vit"), build=_build_owlvit))
 register(ModelFamily(name="yolos", matches=("yolos",), build=_build_yolos))
 register(
     # plain DETR; matched AFTER rtdetr so "rtdetr*" names never land here

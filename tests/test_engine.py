@@ -87,3 +87,20 @@ def test_yolos_family_end_to_end():
     results = eng.detect(_imgs(2, hw=(50, 70)))
     assert len(results) == 2
     assert all(len(d) > 0 for d in results)
+
+
+def test_owlvit_family_end_to_end(monkeypatch):
+    """Tiny OWL-ViT: cached text-query embeds ride apply_kwargs; labels come
+    from the deploy-time query list, not checkpoint metadata."""
+    monkeypatch.setenv("SPOTTER_TPU_TEXT_QUERIES", "tv,couch,bed")
+    built = build_detector("google/owlvit-base-patch32")
+    assert built.postprocess == "sigmoid_max"
+    assert built.id2label == {0: "tv", 1: "couch", 2: "bed"}
+    qe = built.apply_kwargs["query_embeds"]
+    assert qe.shape == (3, 16)  # tiny projection_dim
+    np.testing.assert_allclose(np.linalg.norm(qe, axis=-1), np.ones(3), atol=1e-5)
+    eng = InferenceEngine(built, threshold=0.0, batch_buckets=(1, 2))
+    results = eng.detect(_imgs(2, hw=(40, 40)))
+    assert len(results) == 2
+    labels = {d["label"] for dets in results for d in dets}
+    assert labels <= {"tv", "couch", "bed"} and labels

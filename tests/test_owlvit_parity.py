@@ -1,0 +1,121 @@
+"""Numerical parity: Flax OwlViTDetector vs HF torch OwlViTForObjectDetection.
+
+Tiny random-init config; queries with varying EOT positions and padding so
+the causal+padding text mask and EOT pooling are exercised, plus the
+text-embed caching split (encode_text once, vision-only forward after).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import OwlViTConfig as HFOwlViTConfig
+from transformers.models.owlvit.modeling_owlvit import OwlViTForObjectDetection
+
+from spotter_tpu.convert.owlvit_rules import owlvit_rules
+from spotter_tpu.convert.torch_to_jax import convert_state_dict
+from spotter_tpu.models.configs import OwlViTConfig
+from spotter_tpu.models.owlvit import OwlViTDetector
+
+
+def _tiny_hf_config():
+    return HFOwlViTConfig(
+        text_config=dict(
+            vocab_size=99,
+            hidden_size=16,
+            intermediate_size=24,
+            num_hidden_layers=2,
+            num_attention_heads=2,
+            max_position_embeddings=8,
+        ),
+        vision_config=dict(
+            hidden_size=20,
+            intermediate_size=28,
+            num_hidden_layers=2,
+            num_attention_heads=2,
+            image_size=32,
+            patch_size=8,
+        ),
+        projection_dim=16,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_pair():
+    hf_cfg = _tiny_hf_config()
+    torch.manual_seed(0)
+    model = OwlViTForObjectDetection(hf_cfg).eval()
+    cfg = OwlViTConfig.from_hf(hf_cfg)
+    params = convert_state_dict(model.state_dict(), owlvit_rules(cfg), strict=True)
+    return model, cfg, params
+
+
+# (Q, T): nonzero first token (HF derives query_mask from it), EOT (max id 98)
+# at varying positions, zero padding after.
+QUERY_IDS = np.array(
+    [
+        [5, 7, 98, 0, 0, 0, 0, 0],
+        [5, 9, 12, 98, 0, 0, 0, 0],
+        [5, 98, 0, 0, 0, 0, 0, 0],
+    ],
+    dtype=np.int64,
+)
+
+
+def test_owlvit_detection_parity(tiny_pair):
+    model, cfg, params = tiny_pair
+    rng = np.random.default_rng(1)
+    pixels = rng.uniform(-1, 1, size=(2, 3, 32, 32)).astype(np.float32)
+    attn = (QUERY_IDS != 0).astype(np.int64)
+
+    with torch.no_grad():
+        tout = model(
+            input_ids=torch.from_numpy(np.tile(QUERY_IDS, (2, 1))),  # per-image tile
+            pixel_values=torch.from_numpy(pixels),
+            attention_mask=torch.from_numpy(np.tile(attn, (2, 1))),
+        )
+
+    jout = OwlViTDetector(cfg).apply(
+        {"params": params},
+        np.transpose(pixels, (0, 2, 3, 1)),
+        QUERY_IDS.astype(np.int32),
+        attn.astype(np.int32),
+        method=OwlViTDetector.detect_with_text,
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(jout["pred_boxes"]), tout.pred_boxes.numpy(), atol=2e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(jout["logits"]), tout.logits.numpy(), atol=5e-4, rtol=1e-3
+    )
+
+
+def test_owlvit_cached_query_path_matches(tiny_pair):
+    """Build-time encode_text + vision-only __call__ == fused forward."""
+    _, cfg, params = tiny_pair
+    module = OwlViTDetector(cfg)
+    attn = (QUERY_IDS != 0).astype(np.int32)
+    ids = QUERY_IDS.astype(np.int32)
+
+    fused = module.apply(
+        {"params": params},
+        np.zeros((1, 32, 32, 3), np.float32),
+        ids,
+        attn,
+        method=OwlViTDetector.detect_with_text,
+    )
+    qe = module.apply({"params": params}, ids, attn, method=OwlViTDetector.encode_text)
+    assert np.asarray(qe).shape == (3, cfg.projection_dim)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(qe), axis=-1), np.ones(3), atol=1e-5
+    )
+    split = module.apply(
+        {"params": params}, np.zeros((1, 32, 32, 3), np.float32), np.asarray(qe)
+    )
+    np.testing.assert_allclose(
+        np.asarray(split["logits"]), np.asarray(fused["logits"]), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(split["pred_boxes"]), np.asarray(fused["pred_boxes"]), atol=1e-5
+    )
