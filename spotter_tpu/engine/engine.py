@@ -162,18 +162,31 @@ class InferenceEngine:
             pixels = np.concatenate([pixels, np.zeros((pad, *pixels.shape[1:]), pixels.dtype)])
             masks = np.concatenate([masks, np.ones((pad, *masks.shape[1:]), masks.dtype)])
             sizes = np.concatenate([sizes, np.ones((pad, 2), sizes.dtype)])
+        t_pre = time.monotonic()
         scores, labels, boxes = self._forward(
             self.params,
             jax.device_put(pixels, self._in_sharding),
             jax.device_put(masks, self._in_sharding),
             jax.device_put(sizes, self._in_sharding),
         )
+        # device_get bounds the device stage: it returns only when the
+        # compute and the D2H copy have actually finished
         scores, labels, boxes = jax.device_get((scores, labels, boxes))
+        t_dev = time.monotonic()
         out = [
             to_detections(
                 scores[j], labels[j], boxes[j], self.built.id2label, self.threshold
             )
             for j in range(n)
         ]
-        self.metrics.record_batch(n, time.monotonic() - t0)
+        t_post = time.monotonic()
+        self.metrics.record_batch(
+            n,
+            t_post - t0,
+            stages={
+                "preprocess": t_pre - t0,
+                "device": t_dev - t_pre,
+                "postprocess": t_post - t_dev,
+            },
+        )
         return out

@@ -23,14 +23,30 @@ class Metrics:
         # it without mutating shared state, so concurrent scrapers don't
         # corrupt each other's view
         self._arrivals: deque[tuple[float, int]] = deque(maxlen=window)
+        self._stages: dict[str, deque[float]] = {}
 
-    def record_batch(self, batch_size: int, latency_s: float) -> None:
+    def record_batch(
+        self,
+        batch_size: int,
+        latency_s: float,
+        stages: dict[str, float] | None = None,
+    ) -> None:
+        """`stages`: optional per-stage seconds (e.g. preprocess/device/
+        postprocess) — the breakdown SURVEY.md §5.1 calls for."""
         with self._lock:
             self._images_total += batch_size
             self._batches_total += 1
             self._batch_sizes.append(batch_size)
             self._latencies_ms.append(latency_s * 1000.0)
             self._arrivals.append((time.monotonic(), batch_size))
+            if stages:
+                for name, secs in stages.items():
+                    ring = self._stages.get(name)
+                    if ring is None:
+                        ring = self._stages[name] = deque(
+                            maxlen=self._latencies_ms.maxlen
+                        )
+                    ring.append(secs * 1000.0)
 
     def record_error(self, n: int = 1) -> None:
         with self._lock:
@@ -53,7 +69,14 @@ class Metrics:
                     return 0.0
                 return lats[min(int(p * len(lats)), len(lats) - 1)]
 
+            stage_p50 = {}
+            for name, ring in self._stages.items():
+                vals = sorted(ring)
+                if vals:
+                    stage_p50[f"stage_{name}_ms_p50"] = vals[len(vals) // 2]
+
             return {
+                **stage_p50,
                 "images_total": self._images_total,
                 "errors_total": self._errors_total,
                 "batches_total": self._batches_total,

@@ -1,0 +1,82 @@
+"""Profiling/tracing — the subsystem the reference lacks (SURVEY.md §5.1).
+
+Three mechanisms, all opt-in and zero-cost when off:
+
+- `maybe_start_profiler_server()`: starts jax.profiler's gRPC server when
+  `SPOTTER_TPU_PROFILER_PORT` is set, so TensorBoard / xprof can connect and
+  capture live TPU traces from a serving pod.
+- `trace(log_dir)`: context manager around `jax.profiler.trace` for
+  programmatic capture (used by the `/profile` endpoint).
+- `capture(log_dir, duration_s)`: timed start_trace/stop_trace pair — the
+  device work of whatever traffic is in flight lands in the trace.
+
+The per-stage latency breakdown (preprocess / device / postprocess) is in
+`Metrics.record_batch(..., stages=...)` — always on, host-side only.
+"""
+
+import contextlib
+import logging
+import os
+import threading
+import time
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+PROFILER_PORT_ENV = "SPOTTER_TPU_PROFILER_PORT"
+
+_server_lock = threading.Lock()
+_server_started = False
+
+
+def maybe_start_profiler_server() -> int | None:
+    """Start jax.profiler.start_server once if the env asks for it."""
+    global _server_started
+    port = os.environ.get(PROFILER_PORT_ENV, "")
+    if not port:
+        return None
+    with _server_lock:
+        if not _server_started:
+            jax.profiler.start_server(int(port))
+            _server_started = True
+            logger.info("jax profiler server listening on :%s", port)
+    return int(port)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a profiler trace of the enclosed block into log_dir."""
+    with jax.profiler.trace(log_dir):
+        yield log_dir
+
+
+_capture_lock = threading.Lock()
+
+
+def capture(log_dir: str, duration_s: float = 1.0) -> dict:
+    """Timed capture: trace everything the device runs for duration_s.
+
+    Serializes captures (jax.profiler supports one active trace); returns a
+    small summary the /profile endpoint can serve.
+    """
+    duration_s = float(duration_s)
+    if not (0.0 < duration_s <= 60.0):  # also rejects NaN
+        raise ValueError(f"duration_s must be in (0, 60], got {duration_s}")
+    if not _capture_lock.acquire(blocking=False):
+        raise RuntimeError("a profiler capture is already running")
+    try:
+        t0 = time.monotonic()
+        jax.profiler.start_trace(log_dir)
+        try:
+            time.sleep(duration_s)
+        finally:
+            # never leave the process-wide trace running: an orphaned trace
+            # would make every later start_trace fail for the process life
+            jax.profiler.stop_trace()
+        return {
+            "log_dir": log_dir,
+            "duration_s": round(time.monotonic() - t0, 3),
+        }
+    finally:
+        _capture_lock.release()

@@ -194,10 +194,14 @@ class DetrDetector(nn.Module):
             epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="decoder_layernorm"
         )(queries)
 
+        # Heads return fp32 even under bf16 compute: box sigmoid and softmax
+        # scores need the extra mantissa to keep the ±1 px golden contract.
         logits = nn.Dense(
             cfg.num_labels + 1, dtype=self.dtype, name="class_labels_classifier"
         )(queries)
         boxes = nn.sigmoid(
-            MLPHead(cfg.d_model, 4, 3, dtype=self.dtype, name="bbox_predictor")(queries)
+            MLPHead(cfg.d_model, 4, 3, dtype=self.dtype, name="bbox_predictor")(
+                queries
+            ).astype(jnp.float32)
         )
-        return {"logits": logits, "pred_boxes": boxes}
+        return {"logits": logits.astype(jnp.float32), "pred_boxes": boxes}

@@ -250,7 +250,8 @@ class OwlViTBoxHead(nn.Module):
         x = nn.gelu(x, approximate=False)
         x = nn.Dense(4, dtype=self.dtype, name="dense2")(x)
         bias = owlvit_box_bias(*grid_hw)  # numpy: XLA constant-folds it
-        return nn.sigmoid(x + jnp.asarray(bias, self.dtype))
+        # fp32 sigmoid under bf16 compute (box precision at full-image scale)
+        return nn.sigmoid(x.astype(jnp.float32) + jnp.asarray(bias, jnp.float32))
 
 
 class OwlViTDetector(nn.Module):
@@ -300,7 +301,7 @@ class OwlViTDetector(nn.Module):
         gh = pixel_values.shape[1] // self.config.vision.patch_size
         gw = pixel_values.shape[2] // self.config.vision.patch_size
         boxes = self.box_head(image_feats, (gh, gw))
-        return {"logits": logits, "pred_boxes": boxes}
+        return {"logits": logits.astype(jnp.float32), "pred_boxes": boxes}
 
     def detect_with_text(
         self,

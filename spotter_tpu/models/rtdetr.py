@@ -9,9 +9,10 @@ shapes so jit compiles once per input bucket:
 
 - anchors, sin-cos position tables, and per-level token spans are computed in
   numpy at trace time from static spatial shapes — XLA constant-folds them;
-- multiscale deformable attention is a gather-based bilinear sample (see
-  layers.grid_sample_bilinear_nhwc), which XLA lowers to dynamic-gathers that
-  run well on TPU (no torch grid_sample / custom CUDA needed);
+- multiscale deformable attention runs through the shared sampling core
+  (spotter_tpu/ops/msda.py): XLA row-gathers by default — the fast lowering
+  on TPU — with an opt-in fused Pallas lane-gather kernel; this is the
+  TPU-native replacement for the torch lineage's custom CUDA sampler;
 - the whole forward is one jit region: backbone -> encoder -> decoder ->
   (logits, boxes); no data-dependent control flow.
 """
@@ -29,11 +30,11 @@ from spotter_tpu.models.layers import (
     MLPHead,
     MultiHeadAttention,
     get_activation,
-    grid_sample_bilinear_nhwc,
     inverse_sigmoid,
     sincos_2d_position_embedding,
 )
 from spotter_tpu.models.resnet import ResNetBackbone
+from spotter_tpu.ops.msda import deformable_sampling
 
 
 def generate_anchors(
@@ -190,32 +191,11 @@ class DeformableAttention(nn.Module):
         loc = ref_xy + offsets * jnp.asarray(n_points_scale, self.dtype) * ref_wh * self.offset_scale
         # loc: (B, Q, H, L*P, 2) in [0, 1]
 
-        sampled = []
-        start = 0
-        for lvl, (h, w) in enumerate(spatial_shapes):
-            v = value[:, start : start + h * w]  # (B, hw, heads, hd)
-            start += h * w
-            v = v.transpose(0, 2, 1, 3).reshape(b * heads, h, w, head_dim)
-            g = loc[:, :, :, lvl * points : (lvl + 1) * points, :]
-            g = g.transpose(0, 2, 1, 3, 4).reshape(b * heads, q, points, 2)
-            if self.method == "discrete":
-                wh_vec = jnp.asarray([w, h], self.dtype)
-                coord = jnp.floor(g * wh_vec + 0.5).astype(jnp.int32)
-                cx = jnp.clip(coord[..., 0], 0, w - 1)
-                cy = jnp.clip(coord[..., 1], 0, h - 1)
-                flat = v.reshape(b * heads, h * w, head_dim)
-                idx = (cy * w + cx).reshape(b * heads, -1, 1)
-                out = jnp.take_along_axis(flat, idx, axis=1).reshape(
-                    b * heads, q, points, head_dim
-                )
-            else:
-                out = grid_sample_bilinear_nhwc(v, 2.0 * g - 1.0)
-            sampled.append(out)
-        sampled = jnp.concatenate(sampled, axis=2)  # (B*H, Q, L*P, hd)
-
-        aw = attn.transpose(0, 2, 1, 3).reshape(b * heads, q, levels * points, 1)
-        out = (sampled * aw).sum(axis=2)  # (B*H, Q, hd)
-        out = out.reshape(b, heads, q, head_dim).transpose(0, 2, 1, 3).reshape(b, q, self.d_model)
+        # Shared sampling core (spotter_tpu/ops/msda.py): XLA row-gathers by
+        # default, opt-in fused Pallas kernel via SPOTTER_TPU_MSDA.
+        out = deformable_sampling(
+            value, loc, attn, spatial_shapes, points, method=self.method
+        )
         return nn.Dense(self.d_model, dtype=self.dtype, name="output_proj")(out)
 
 
@@ -394,7 +374,7 @@ class RTDetrDetector(nn.Module):
         gather = lambda arr: jnp.take_along_axis(arr, topk_ind[..., None], axis=1)
         reference_logits = gather(enc_coord_logits)
         enc_topk_logits = gather(enc_class)
-        enc_topk_bboxes = nn.sigmoid(reference_logits)
+        enc_topk_bboxes = nn.sigmoid(reference_logits.astype(jnp.float32))
 
         if cfg.learn_initial_query:
             target = self.param(
@@ -414,21 +394,26 @@ class RTDetrDetector(nn.Module):
             )
 
         # --- decoder with iterative refinement ---
-        ref = nn.sigmoid(reference_logits)
+        # Box-refinement arithmetic stays fp32 even under bf16 compute: the
+        # sigmoid/inverse-sigmoid iteration across decoder layers would
+        # otherwise accumulate bf16 rounding into multi-pixel box drift
+        # (the heavy matmuls in DecoderLayer/MLPHead still run self.dtype).
+        ref = nn.sigmoid(reference_logits.astype(jnp.float32))
         h = target
         query_pos_head = MLPHead(
             2 * cfg.d_model, cfg.d_model, 2, dtype=self.dtype, name="query_pos_head"
         )
         aux_logits, aux_boxes = [], []
         for i in range(cfg.decoder_layers):
-            pos = query_pos_head(ref)
+            pos = query_pos_head(ref.astype(self.dtype))
             h = DecoderLayer(cfg, dtype=self.dtype, name=f"decoder_layer{i}")(
-                h, pos, source_flatten, ref, spatial_shapes, self_attention_mask
+                h, pos, source_flatten, ref.astype(self.dtype), spatial_shapes,
+                self_attention_mask,
             )
             box_delta = MLPHead(cfg.d_model, 4, 3, dtype=self.dtype, name=f"bbox_head{i}")(h)
-            new_ref = nn.sigmoid(box_delta + inverse_sigmoid(ref))
+            new_ref = nn.sigmoid(box_delta.astype(jnp.float32) + inverse_sigmoid(ref))
             logits_i = nn.Dense(cfg.num_labels, dtype=self.dtype, name=f"class_head{i}")(h)
-            aux_logits.append(logits_i)
+            aux_logits.append(logits_i.astype(jnp.float32))
             aux_boxes.append(new_ref)
             ref = jax.lax.stop_gradient(new_ref)
 
@@ -437,6 +422,6 @@ class RTDetrDetector(nn.Module):
             "pred_boxes": aux_boxes[-1],
             "aux_logits": jnp.stack(aux_logits, axis=1),
             "aux_boxes": jnp.stack(aux_boxes, axis=1),
-            "enc_topk_logits": enc_topk_logits,
+            "enc_topk_logits": enc_topk_logits.astype(jnp.float32),
             "enc_topk_bboxes": enc_topk_bboxes,
         }

@@ -155,11 +155,14 @@ class YolosDetector(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="layernorm")(x)
         det_out = x[:, -t:]
 
+        # fp32 head outputs under bf16 compute (box precision at 640 px scale)
         logits = MLPHead(
             cfg.hidden_size, cfg.num_labels + 1, 3, dtype=self.dtype,
             name="class_labels_classifier",
         )(det_out)
         boxes = nn.sigmoid(
-            MLPHead(cfg.hidden_size, 4, 3, dtype=self.dtype, name="bbox_predictor")(det_out)
+            MLPHead(cfg.hidden_size, 4, 3, dtype=self.dtype, name="bbox_predictor")(
+                det_out
+            ).astype(jnp.float32)
         )
-        return {"logits": logits, "pred_boxes": boxes}
+        return {"logits": logits.astype(jnp.float32), "pred_boxes": boxes}

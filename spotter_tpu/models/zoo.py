@@ -35,6 +35,7 @@ from spotter_tpu.models.owlvit import OwlViTDetector
 from spotter_tpu.models.yolos import YolosDetector
 from spotter_tpu.models.registry import ModelFamily, register
 from spotter_tpu.models.rtdetr import RTDetrDetector
+from spotter_tpu.utils.precision import compute_dtype
 from spotter_tpu.ops.preprocess import (
     CLIP_MEAN,
     CLIP_STD,
@@ -82,7 +83,7 @@ def _build_rtdetr(model_name: str) -> BuiltDetector:
     if os.environ.get(TINY_ENV):
         cfg = tiny_rtdetr_config()
         spec = PreprocessSpec(mode="fixed", size=(64, 64))
-        module = RTDetrDetector(cfg)
+        module = RTDetrDetector(cfg, dtype=compute_dtype())
         params = _init_random(module, spec.input_hw)
         logger.info("Built tiny random RT-DETR for %s (%s)", model_name, TINY_ENV)
     else:
@@ -90,7 +91,7 @@ def _build_rtdetr(model_name: str) -> BuiltDetector:
 
         cfg, params = load_rtdetr_from_hf(model_name)
         spec = RTDETR_SPEC
-        module = RTDetrDetector(cfg)
+        module = RTDetrDetector(cfg, dtype=compute_dtype())
     return BuiltDetector(
         model_name=model_name,
         module=module,
@@ -128,7 +129,7 @@ def _build_detr(model_name: str) -> BuiltDetector:
             mode="shortest_edge", size=(48, 64), mean=IMAGENET_MEAN, std=IMAGENET_STD,
             pad_to=(64, 64),
         )
-        module = DetrDetector(cfg)
+        module = DetrDetector(cfg, dtype=compute_dtype())
         params = _init_random(module, spec.input_hw)
         logger.info("Built tiny random DETR for %s (%s)", model_name, TINY_ENV)
     else:
@@ -136,7 +137,7 @@ def _build_detr(model_name: str) -> BuiltDetector:
 
         cfg, params = load_detr_from_hf(model_name)
         spec = DETR_SPEC
-        module = DetrDetector(cfg)
+        module = DetrDetector(cfg, dtype=compute_dtype())
     return BuiltDetector(
         model_name=model_name,
         module=module,
@@ -166,7 +167,7 @@ def tiny_yolos_config(num_labels: int = 80) -> YolosConfig:
 def _build_yolos(model_name: str) -> BuiltDetector:
     if os.environ.get(TINY_ENV):
         cfg = tiny_yolos_config()
-        module = YolosDetector(cfg)
+        module = YolosDetector(cfg, dtype=compute_dtype())
         spec = PreprocessSpec(
             mode="fixed", size=cfg.image_size, mean=IMAGENET_MEAN, std=IMAGENET_STD
         )
@@ -176,7 +177,7 @@ def _build_yolos(model_name: str) -> BuiltDetector:
         from spotter_tpu.convert.loader import load_yolos_from_hf  # lazy: needs torch
 
         cfg, params = load_yolos_from_hf(model_name)
-        module = YolosDetector(cfg)
+        module = YolosDetector(cfg, dtype=compute_dtype())
         # Warp-resize to the trained image size: position tables apply exactly
         # and every shape is static. (The torch processor instead pads to the
         # batch max and interpolates position tables per size — a recompile
@@ -238,7 +239,7 @@ def _build_owlvit(model_name: str) -> BuiltDetector:
     prompts = [f"a photo of a {label}" for label in labels]
     if os.environ.get(TINY_ENV):
         cfg = tiny_owlvit_config()
-        module = OwlViTDetector(cfg)
+        module = OwlViTDetector(cfg, dtype=compute_dtype())
         spec = PreprocessSpec(mode="fixed", size=(32, 32), mean=CLIP_MEAN, std=CLIP_STD)
         rng = np.random.default_rng(0)
         t = cfg.text.max_position_embeddings
@@ -259,7 +260,7 @@ def _build_owlvit(model_name: str) -> BuiltDetector:
         )
 
         cfg, params = load_owlvit_from_hf(model_name)
-        module = OwlViTDetector(cfg)
+        module = OwlViTDetector(cfg, dtype=compute_dtype())
         spec = OWLVIT_SPEC
         ids, mask = owlvit_tokenize(model_name, prompts, cfg.text.max_position_embeddings)
     # TPU-first split: the text tower runs ONCE here; the serving hot path is

@@ -7,20 +7,33 @@ throughput/latency counters that the reference lacks).
 """
 
 import argparse
+import asyncio
 import json
 import logging
+import os
+import tempfile
 
 import pydantic
 from aiohttp import web
 
+from spotter_tpu.engine import profiler
 from spotter_tpu.serving.app import build_detector_app
 
 logger = logging.getLogger(__name__)
 
 
+def _rmdir_quiet(path: str) -> None:
+    """Drop a just-created empty trace dir on failed /profile requests."""
+    try:
+        os.rmdir(path)
+    except OSError:  # non-empty (trace partially written) or already gone
+        pass
+
+
 def make_app(detector=None, model_name: str | None = None, warmup: bool = False) -> web.Application:
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["detector"] = detector or build_detector_app(model_name, warmup=warmup)
+    profiler.maybe_start_profiler_server()
 
     async def detect(request: web.Request) -> web.Response:
         try:
@@ -42,12 +55,47 @@ def make_app(detector=None, model_name: str | None = None, warmup: bool = False)
     async def metrics(request: web.Request) -> web.Response:
         return web.json_response(request.app["detector"].engine.metrics.snapshot())
 
+    async def profile(request: web.Request) -> web.Response:
+        """Capture a jax.profiler trace of in-flight device work.
+
+        Body (optional JSON): {"duration_s": 1.0}. The server picks the
+        trace directory (under SPOTTER_TPU_PROFILE_DIR or the system temp
+        dir — never a client-supplied path) and returns it; open it with
+        TensorBoard/xprof.
+        """
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            body = {}
+        if not isinstance(body, dict):
+            return web.Response(status=400, text="body must be a JSON object")
+        try:
+            duration_s = min(float(body.get("duration_s", 1.0)), 30.0)
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="duration_s must be a number")
+        if not duration_s > 0.0:  # also rejects NaN before any dir is made
+            return web.Response(status=400, text="duration_s must be > 0")
+        base = os.environ.get("SPOTTER_TPU_PROFILE_DIR")
+        log_dir = tempfile.mkdtemp(prefix="spotter-trace-", dir=base or None)
+        try:
+            summary = await asyncio.get_running_loop().run_in_executor(
+                None, profiler.capture, log_dir, duration_s
+            )
+        except ValueError as exc:  # bad duration (e.g. <= 0, NaN)
+            _rmdir_quiet(log_dir)
+            return web.Response(status=400, text=str(exc))
+        except RuntimeError as exc:  # capture already in progress
+            _rmdir_quiet(log_dir)
+            return web.Response(status=409, text=str(exc))
+        return web.json_response(summary)
+
     async def on_cleanup(app: web.Application) -> None:
         await app["detector"].aclose()
 
     app.router.add_post("/detect", detect)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_post("/profile", profile)
     app.on_cleanup.append(on_cleanup)
     return app
 

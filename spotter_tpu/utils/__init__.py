@@ -1,0 +1,3 @@
+from spotter_tpu.utils.precision import compute_dtype
+
+__all__ = ["compute_dtype"]

@@ -1,0 +1,190 @@
+"""Fused MSDA sampling op: XLA/Pallas parity, both methods, gradients.
+
+The op (spotter_tpu/ops/msda.py) replaces the per-level grid-sample chain.
+Reference semantics here are the original formulation via
+`grid_sample_bilinear_nhwc` (torch grid_sample parity, zeros padding,
+align_corners=False) — the same math the torch lineage's CUDA sampler
+implements (HF modeling_rt_detr_v2 multi_scale_deformable_attention_v2).
+Pallas runs in interpret mode on the CPU test mesh (SURVEY.md §4.4).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spotter_tpu.models.layers import grid_sample_bilinear_nhwc
+from spotter_tpu.ops.msda import (
+    MSDA_ENV,
+    deformable_sampling,
+    msda_backend,
+    prepare_msda_gather,
+    pallas_deformable_sampling,
+    xla_deformable_sampling,
+)
+
+SHAPES = ((8, 8), (4, 4), (2, 2))
+B, Q, H, HD, P = 2, 7, 4, 8, 3
+LP = len(SHAPES) * P
+S = sum(h * w for h, w in SHAPES)
+
+
+def _random_inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    value = rng.standard_normal((B, S, H, HD)).astype(np.float32)
+    # locations mostly inside [0,1] with some outside to exercise zero-padding
+    loc = rng.uniform(-0.2, 1.2, (B, Q, H, LP, 2)).astype(np.float32)
+    attn = jax.nn.softmax(
+        jnp.asarray(rng.standard_normal((B, Q, H, LP)).astype(np.float32)), axis=-1
+    )
+    return jnp.asarray(value), jnp.asarray(loc), np.asarray(attn)
+
+
+def _reference(value, loc, attn):
+    """Original per-level grid-sample formulation (pre-fusion module code)."""
+    sampled = []
+    start = 0
+    for lvl, (h, w) in enumerate(SHAPES):
+        v = value[:, start : start + h * w]
+        start += h * w
+        v = v.transpose(0, 2, 1, 3).reshape(B * H, h, w, HD)
+        g = loc[:, :, :, lvl * P : (lvl + 1) * P, :]
+        g = g.transpose(0, 2, 1, 3, 4).reshape(B * H, Q, P, 2)
+        sampled.append(grid_sample_bilinear_nhwc(v, 2.0 * g - 1.0))
+    sampled = jnp.concatenate(sampled, axis=2)
+    aw = jnp.asarray(attn).transpose(0, 2, 1, 3).reshape(B * H, Q, LP, 1)
+    out = (sampled * aw).sum(axis=2)
+    return out.reshape(B, H, Q, HD).transpose(0, 2, 1, 3).reshape(B, Q, H * HD)
+
+
+def test_xla_path_matches_grid_sample_reference():
+    value, loc, attn = _random_inputs()
+    got = deformable_sampling(value, loc, attn, SHAPES, P, backend="xla")
+    ref = _reference(value, loc, attn)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("backend", ["pallas", "pallas_gather"])
+def test_pallas_interpret_matches_xla(backend):
+    value, loc, attn = _random_inputs(1)
+    got = deformable_sampling(
+        value, loc, attn, SHAPES, P, backend=backend, interpret=True
+    )
+    ref = deformable_sampling(value, loc, attn, SHAPES, P, backend="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_discrete_method_parity():
+    """Discrete (nearest, border-clamped) path: XLA vs original formulation."""
+    value, loc, attn = _random_inputs(2)
+    got = deformable_sampling(value, loc, attn, SHAPES, P, method="discrete", backend="xla")
+    pal = deformable_sampling(
+        value, loc, attn, SHAPES, P, method="discrete", backend="pallas", interpret=True
+    )
+    pg = deformable_sampling(
+        value, loc, attn, SHAPES, P, method="discrete",
+        backend="pallas_gather", interpret=True,
+    )
+    # original discrete formulation from the module (pre-fusion)
+    sampled = []
+    start = 0
+    for lvl, (h, w) in enumerate(SHAPES):
+        v = value[:, start : start + h * w]
+        start += h * w
+        flat = v.transpose(0, 2, 1, 3).reshape(B * H, h * w, HD)
+        g = loc[:, :, :, lvl * P : (lvl + 1) * P, :]
+        g = g.transpose(0, 2, 1, 3, 4).reshape(B * H, Q, P, 2)
+        coord = jnp.floor(g * jnp.asarray([w, h], jnp.float32) + 0.5).astype(jnp.int32)
+        cx = jnp.clip(coord[..., 0], 0, w - 1)
+        cy = jnp.clip(coord[..., 1], 0, h - 1)
+        idx = (cy * w + cx).reshape(B * H, -1, 1)
+        sampled.append(
+            jnp.take_along_axis(flat, idx, axis=1).reshape(B * H, Q, P, HD)
+        )
+    sampled = jnp.concatenate(sampled, axis=2)
+    aw = jnp.asarray(attn).transpose(0, 2, 1, 3).reshape(B * H, Q, LP, 1)
+    ref = (sampled * aw).sum(axis=2)
+    ref = ref.reshape(B, H, Q, HD).transpose(0, 2, 1, 3).reshape(B, Q, H * HD)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pg), np.asarray(ref), atol=1e-5)
+
+
+def test_pallas_gather_gradients_match_xla():
+    """custom_vjp backward == autodiff through the XLA path (train parity)."""
+    value, loc, attn = _random_inputs(3)
+    loc_t = loc.transpose(0, 2, 3, 1, 4)
+    attn_t = jnp.asarray(attn).transpose(0, 2, 3, 1)
+    idx, w = prepare_msda_gather(loc_t, attn_t, SHAPES, P)
+    vt = value.transpose(0, 2, 3, 1)  # (B, H, HD, S)
+
+    def loss_pallas(vt, w):
+        return (
+            pallas_deformable_sampling(vt, idx, w, LP, Q, True) ** 2
+        ).sum()
+
+    def loss_xla(vt, w):
+        return (xla_deformable_sampling(vt, idx, w, LP, Q) ** 2).sum()
+
+    gp_v, gp_w = jax.grad(loss_pallas, argnums=(0, 1))(vt, w)
+    gx_v, gx_w = jax.grad(loss_xla, argnums=(0, 1))(vt, w)
+    np.testing.assert_allclose(np.asarray(gp_v), np.asarray(gx_v), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gp_w), np.asarray(gx_w), atol=1e-4)
+
+
+def test_onehot_gradients_match_xla():
+    """One-hot kernel custom_vjp == autodiff through the sampling op."""
+    value, loc, attn = _random_inputs(5)
+
+    def loss(backend):
+        def f(v, a):
+            out = deformable_sampling(
+                v, loc, a, SHAPES, P, backend=backend, interpret=True
+            )
+            return (out**2).sum()
+
+        return f
+
+    gp_v, gp_a = jax.grad(loss("pallas"), argnums=(0, 1))(value, jnp.asarray(attn))
+    gx_v, gx_a = jax.grad(loss("xla"), argnums=(0, 1))(value, jnp.asarray(attn))
+    np.testing.assert_allclose(np.asarray(gp_v), np.asarray(gx_v), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gp_a), np.asarray(gx_a), atol=1e-4)
+
+
+@pytest.mark.tpu
+@pytest.mark.parametrize("backend", ["pallas", "pallas_gather"])
+def test_pallas_compiled_on_tpu(backend):
+    """Mosaic-compiled kernels vs XLA on hardware.
+
+    The one-hot kernel must work at any source size; the gather kernel is
+    pinned at its single-vreg envelope ("Multiple source vregs along gather
+    dimension" beyond 128 lanes) so a Mosaic upgrade lifting it is noticed.
+    """
+    if jax.default_backend() != "tpu":
+        pytest.skip("requires TPU hardware")
+    value, loc, attn = _random_inputs(4)
+    got = jax.jit(
+        lambda v, l, a: deformable_sampling(v, l, a, SHAPES, P, backend=backend)
+    )(value, loc, attn)
+    ref = deformable_sampling(value, loc, attn, SHAPES, P, backend="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_backend_policy(monkeypatch):
+    monkeypatch.delenv(MSDA_ENV, raising=False)
+    # auto: shape-aware on TPU (xla below the gather cliff, one-hot kernel
+    # above), always XLA on CPU/GPU
+    if jax.default_backend() == "tpu":
+        assert msda_backend(batch_heads=64) == "xla"
+        assert msda_backend(batch_heads=128) == "pallas"
+    else:
+        assert msda_backend(batch_heads=128) == "xla"
+    assert msda_backend() == "xla"
+    monkeypatch.setenv(MSDA_ENV, "pallas")
+    assert msda_backend() == "pallas"
+    assert msda_backend("xla") == "xla"
+    assert msda_backend("pallas_gather") == "pallas_gather"
+    monkeypatch.setenv(MSDA_ENV, "nope")
+    with pytest.raises(ValueError):
+        msda_backend()

@@ -1,0 +1,118 @@
+"""Profiling subsystem (SURVEY.md §5.1): trace capture, per-stage metrics,
+and the /profile endpoint — all on the CPU test backend."""
+
+import asyncio
+import glob
+import os
+from io import BytesIO
+from unittest.mock import AsyncMock
+
+import httpx
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from PIL import Image
+
+os.environ["SPOTTER_TPU_TINY"] = "1"
+
+import jax.numpy as jnp
+
+from spotter_tpu.engine import profiler
+from spotter_tpu.engine.metrics import Metrics
+
+
+def test_capture_writes_trace(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    # some device work for the trace window
+    _ = jnp.ones((128, 128)) @ jnp.ones((128, 128))
+    summary = profiler.capture(log_dir, duration_s=0.05)
+    assert summary["log_dir"] == log_dir
+    # jax writes plugins/profile/<ts>/*.xplane.pb under the log dir
+    assert glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
+
+
+def test_trace_context(tmp_path):
+    log_dir = str(tmp_path / "ctx")
+    with profiler.trace(log_dir):
+        _ = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+    assert glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
+
+
+def test_capture_rejects_bad_duration(tmp_path):
+    # would otherwise wedge the process-wide profiler (start without stop)
+    with pytest.raises(ValueError):
+        profiler.capture(str(tmp_path / "bad"), duration_s=-1.0)
+    with pytest.raises(ValueError):
+        profiler.capture(str(tmp_path / "nan"), duration_s=float("nan"))
+    # the profiler is still usable afterwards
+    summary = profiler.capture(str(tmp_path / "ok"), duration_s=0.01)
+    assert summary["log_dir"].endswith("ok")
+
+
+def test_profiler_server_env(monkeypatch):
+    monkeypatch.delenv(profiler.PROFILER_PORT_ENV, raising=False)
+    assert profiler.maybe_start_profiler_server() is None
+
+
+def test_stage_metrics_in_snapshot():
+    m = Metrics()
+    m.record_batch(4, 0.100, stages={"preprocess": 0.010, "device": 0.080})
+    m.record_batch(4, 0.120, stages={"preprocess": 0.014, "device": 0.090})
+    snap = m.snapshot()
+    assert snap["stage_preprocess_ms_p50"] == pytest.approx(14.0)
+    assert snap["stage_device_ms_p50"] == pytest.approx(90.0)
+    assert snap["images_total"] == 8
+
+
+def test_profile_endpoint(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPOTTER_TPU_PROFILE_DIR", str(tmp_path))
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.engine.engine import InferenceEngine
+    from spotter_tpu.models import build_detector
+    from spotter_tpu.serving.detector import AmenitiesDetector
+    from spotter_tpu.serving.standalone import make_app
+
+    def _client_returning_image():
+        img = Image.fromarray(np.full((32, 32, 3), 128, np.uint8))
+        buf = BytesIO()
+        img.save(buf, format="JPEG")
+        resp = AsyncMock()
+        resp.content = buf.getvalue()
+        resp.raise_for_status = lambda: None
+        client = AsyncMock(spec=httpx.AsyncClient)
+        client.get.return_value = resp
+        return client
+
+    async def run():
+        built = build_detector("PekingU/rtdetr_v2_r18vd")
+        engine = InferenceEngine(built, threshold=0.0, batch_buckets=(1,))
+        detector = AmenitiesDetector(
+            engine, MicroBatcher(engine, max_delay_ms=1.0), _client_returning_image()
+        )
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post("/profile", json={"duration_s": 0.05})
+            assert resp.status == 200
+            body = await resp.json()
+            # server picks the dir (client paths rejected by design) under
+            # SPOTTER_TPU_PROFILE_DIR
+            assert body["log_dir"].startswith(str(tmp_path))
+            assert glob.glob(
+                os.path.join(body["log_dir"], "**", "*.xplane.pb"), recursive=True
+            )
+            # malformed bodies are 400s, like /detect
+            assert (await client.post("/profile", json=[1])).status == 400
+            assert (
+                await client.post("/profile", json={"duration_s": "abc"})
+            ).status == 400
+            assert (
+                await client.post("/profile", json={"duration_s": -1})
+            ).status == 400
+            # per-stage breakdown shows up in /metrics after one detect
+            await client.post(
+                "/detect", json={"image_urls": ["http://example.com/a.jpg"]}
+            )
+            snap = await (await client.get("/metrics")).json()
+            assert "stage_device_ms_p50" in snap
+
+    asyncio.run(run())
