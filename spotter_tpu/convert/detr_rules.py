@@ -1,4 +1,7 @@
-"""torch->Flax conversion rules for DETR (facebook/detr-resnet-*).
+"""torch->Flax conversion rules for DETR (facebook/detr-resnet-*) and
+Table-Transformer (microsoft/table-transformer-*, whose state dict is DETR's
+plus a closing encoder LayerNorm — modeling_table_transformer.py is a
+pre-norm copy of modeling_detr.py with identical parameter names).
 
 Covers both backbone serializations found in DETR checkpoints:
 - HF ResNetBackbone naming (use_timm_backbone=False):
@@ -96,6 +99,8 @@ def detr_rules(cfg: DetrConfig, backbone_naming: str = "hf") -> Rules:
         r.dense((*f, "fc2"), f"{t}.fc2")
         r.layernorm((*f, "final_layer_norm"), f"{t}.final_layer_norm")
     r.layernorm(("decoder_layernorm",), "model.decoder.layernorm")
+    if cfg.pre_norm:  # Table-Transformer's closing encoder LayerNorm
+        r.layernorm(("encoder_layernorm",), "model.encoder.layernorm")
 
     r.dense(("class_labels_classifier",), "class_labels_classifier")
     r.mlp_head(("bbox_predictor",), "bbox_predictor", 3)

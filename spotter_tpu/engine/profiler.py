@@ -5,16 +5,15 @@ Three mechanisms, all opt-in and zero-cost when off:
 - `maybe_start_profiler_server()`: starts jax.profiler's gRPC server when
   `SPOTTER_TPU_PROFILER_PORT` is set, so TensorBoard / xprof can connect and
   capture live TPU traces from a serving pod.
-- `trace(log_dir)`: context manager around `jax.profiler.trace` for
-  programmatic capture (used by the `/profile` endpoint).
-- `capture(log_dir, duration_s)`: timed start_trace/stop_trace pair — the
-  device work of whatever traffic is in flight lands in the trace.
+- `capture(log_dir, duration_s)`: timed start_trace/stop_trace pair used by
+  the `/profile` endpoint — the device work of whatever traffic is in
+  flight lands in the trace. (For ad-hoc scoped captures, use
+  `jax.profiler.trace` directly — it is already a context manager.)
 
 The per-stage latency breakdown (preprocess / device / postprocess) is in
 `Metrics.record_batch(..., stages=...)` — always on, host-side only.
 """
 
-import contextlib
 import logging
 import os
 import threading
@@ -42,13 +41,6 @@ def maybe_start_profiler_server() -> int | None:
             _server_started = True
             logger.info("jax profiler server listening on :%s", port)
     return int(port)
-
-
-@contextlib.contextmanager
-def trace(log_dir: str):
-    """Capture a profiler trace of the enclosed block into log_dir."""
-    with jax.profiler.trace(log_dir):
-        yield log_dir
 
 
 _capture_lock = threading.Lock()

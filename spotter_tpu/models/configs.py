@@ -146,6 +146,10 @@ class DetrConfig:
     activation_function: str = "relu"
     positional_encoding_temperature: float = 10000.0
     layer_norm_eps: float = 1e-5  # torch nn.LayerNorm default (DETR never overrides)
+    # Table-Transformer (microsoft/table-transformer-*) is DETR with pre-norm
+    # layers and a final encoder LayerNorm (modeling_table_transformer.py
+    # normalizes before attention/FFN; DETR normalizes after)
+    pre_norm: bool = False
     id2label: tuple[tuple[int, str], ...] = ()
 
     @property
@@ -155,10 +159,24 @@ class DetrConfig:
     @classmethod
     def from_hf(cls, hf) -> "DetrConfig":
         if hf.use_timm_backbone:
-            # timm checkpoints (facebook/detr-resnet-50/101) are all classic
-            # bottleneck ResNets; depth comes from the backbone name
-            depths = {"resnet50": (3, 4, 6, 3), "resnet101": (3, 4, 23, 3)}[hf.backbone]
-            backbone = ResNetConfig(style="v1", depths=depths, out_indices=(4,))
+            # timm checkpoints: facebook/detr-resnet-50/101 (bottleneck) and
+            # microsoft/table-transformer-* (resnet18, basic blocks); the
+            # architecture comes from the backbone name
+            timm_presets = {
+                "resnet18": dict(
+                    layer_type="basic", depths=(2, 2, 2, 2),
+                    hidden_sizes=(64, 128, 256, 512),
+                ),
+                "resnet34": dict(
+                    layer_type="basic", depths=(3, 4, 6, 3),
+                    hidden_sizes=(64, 128, 256, 512),
+                ),
+                "resnet50": dict(depths=(3, 4, 6, 3)),
+                "resnet101": dict(depths=(3, 4, 23, 3)),
+            }
+            backbone = ResNetConfig(
+                style="v1", out_indices=(4,), **timm_presets[hf.backbone]
+            )
         else:
             backbone = replace(
                 ResNetConfig.from_hf(hf.backbone_config),
@@ -176,6 +194,7 @@ class DetrConfig:
             encoder_ffn_dim=hf.encoder_ffn_dim,
             decoder_ffn_dim=hf.decoder_ffn_dim,
             activation_function=hf.activation_function,
+            pre_norm=hf.model_type == "table-transformer",
             id2label=tuple(sorted((int(k), v) for k, v in hf.id2label.items())),
         )
 

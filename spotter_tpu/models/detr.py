@@ -66,7 +66,8 @@ def sine_position_from_mask(
 
 
 class DetrEncoderLayer(nn.Module):
-    """Post-norm encoder layer (DetrEncoderLayer): self-attn + FFN."""
+    """Encoder layer: self-attn + FFN. Post-norm (DETR) or pre-norm
+    (Table-Transformer) per config.pre_norm."""
 
     config: DetrConfig
     dtype: jnp.dtype = jnp.float32
@@ -76,22 +77,34 @@ class DetrEncoderLayer(nn.Module):
         self, hidden: jnp.ndarray, pos: jnp.ndarray, attn_mask: Optional[jnp.ndarray]
     ) -> jnp.ndarray:
         cfg = self.config
-        attn = MultiHeadAttention(
-            cfg.d_model, cfg.encoder_attention_heads, dtype=self.dtype, name="self_attn"
-        )(hidden, position_embeddings=pos, attention_mask=attn_mask)
-        hidden = nn.LayerNorm(
+        norm1 = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="self_attn_layer_norm"
-        )(hidden + attn)
-        ffn = nn.Dense(cfg.encoder_ffn_dim, dtype=self.dtype, name="fc1")(hidden)
-        ffn = get_activation(cfg.activation_function)(ffn)
-        ffn = nn.Dense(cfg.d_model, dtype=self.dtype, name="fc2")(ffn)
-        return nn.LayerNorm(
+        )
+        norm2 = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm"
-        )(hidden + ffn)
+        )
+        mha = MultiHeadAttention(
+            cfg.d_model, cfg.encoder_attention_heads, dtype=self.dtype, name="self_attn"
+        )
+
+        def ffn_block(x):
+            y = nn.Dense(cfg.encoder_ffn_dim, dtype=self.dtype, name="fc1")(x)
+            y = get_activation(cfg.activation_function)(y)
+            return nn.Dense(cfg.d_model, dtype=self.dtype, name="fc2")(y)
+
+        if cfg.pre_norm:
+            hidden = hidden + mha(
+                norm1(hidden), position_embeddings=pos, attention_mask=attn_mask
+            )
+            return hidden + ffn_block(norm2(hidden))
+        attn = mha(hidden, position_embeddings=pos, attention_mask=attn_mask)
+        hidden = norm1(hidden + attn)
+        return norm2(hidden + ffn_block(hidden))
 
 
 class DetrDecoderLayer(nn.Module):
-    """Post-norm decoder layer: self-attn over queries + cross-attn to memory."""
+    """Decoder layer: self-attn over queries + cross-attn to memory.
+    Post-norm (DETR) or pre-norm (Table-Transformer) per config.pre_norm."""
 
     config: DetrConfig
     dtype: jnp.dtype = jnp.float32
@@ -106,30 +119,45 @@ class DetrDecoderLayer(nn.Module):
         memory_mask: Optional[jnp.ndarray],
     ) -> jnp.ndarray:
         cfg = self.config
-        attn = MultiHeadAttention(
-            cfg.d_model, cfg.decoder_attention_heads, dtype=self.dtype, name="self_attn"
-        )(queries, position_embeddings=query_pos)
-        queries = nn.LayerNorm(
+        norm1 = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="self_attn_layer_norm"
-        )(queries + attn)
-        cross = MultiHeadAttention(
-            cfg.d_model, cfg.decoder_attention_heads, dtype=self.dtype, name="encoder_attn"
-        )(
-            queries,
-            position_embeddings=query_pos,
-            key_value_states=memory,
-            key_position_embeddings=memory_pos,
-            attention_mask=memory_mask,
         )
-        queries = nn.LayerNorm(
+        norm2 = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="encoder_attn_layer_norm"
-        )(queries + cross)
-        ffn = nn.Dense(cfg.decoder_ffn_dim, dtype=self.dtype, name="fc1")(queries)
-        ffn = get_activation(cfg.activation_function)(ffn)
-        ffn = nn.Dense(cfg.d_model, dtype=self.dtype, name="fc2")(ffn)
-        return nn.LayerNorm(
+        )
+        norm3 = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm"
-        )(queries + ffn)
+        )
+        self_attn = MultiHeadAttention(
+            cfg.d_model, cfg.decoder_attention_heads, dtype=self.dtype, name="self_attn"
+        )
+        cross_attn = MultiHeadAttention(
+            cfg.d_model, cfg.decoder_attention_heads, dtype=self.dtype, name="encoder_attn"
+        )
+
+        def cross(x):
+            return cross_attn(
+                x,
+                position_embeddings=query_pos,
+                key_value_states=memory,
+                key_position_embeddings=memory_pos,
+                attention_mask=memory_mask,
+            )
+
+        def ffn_block(x):
+            y = nn.Dense(cfg.decoder_ffn_dim, dtype=self.dtype, name="fc1")(x)
+            y = get_activation(cfg.activation_function)(y)
+            return nn.Dense(cfg.d_model, dtype=self.dtype, name="fc2")(y)
+
+        if cfg.pre_norm:
+            queries = queries + self_attn(norm1(queries), position_embeddings=query_pos)
+            queries = queries + cross(norm2(queries))
+            return queries + ffn_block(norm3(queries))
+        queries = norm1(
+            queries + self_attn(queries, position_embeddings=query_pos)
+        )
+        queries = norm2(queries + cross(queries))
+        return norm3(queries + ffn_block(queries))
 
 
 class DetrDetector(nn.Module):
@@ -175,6 +203,10 @@ class DetrDetector(nn.Module):
             src = DetrEncoderLayer(cfg, dtype=self.dtype, name=f"encoder_layer{i}")(
                 src, pos, attn_mask
             )
+        if cfg.pre_norm:  # Table-Transformer closes the pre-norm encoder
+            src = nn.LayerNorm(
+                epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="encoder_layernorm"
+            )(src)
 
         query_pos = self.param(
             "query_pos",

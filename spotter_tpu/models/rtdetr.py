@@ -10,9 +10,10 @@ shapes so jit compiles once per input bucket:
 - anchors, sin-cos position tables, and per-level token spans are computed in
   numpy at trace time from static spatial shapes — XLA constant-folds them;
 - multiscale deformable attention runs through the shared sampling core
-  (spotter_tpu/ops/msda.py): XLA row-gathers by default — the fast lowering
-  on TPU — with an opt-in fused Pallas lane-gather kernel; this is the
-  TPU-native replacement for the torch lineage's custom CUDA sampler;
+  (spotter_tpu/ops/msda.py): on TPU the gather-free level-split one-hot
+  Pallas kernel (one-hot weight tiles contracted on the MXU), XLA
+  row-gathers elsewhere; this is the TPU-native replacement for the torch
+  lineage's custom CUDA sampler;
 - the whole forward is one jit region: backbone -> encoder -> decoder ->
   (logits, boxes); no data-dependent control flow.
 """
@@ -191,8 +192,8 @@ class DeformableAttention(nn.Module):
         loc = ref_xy + offsets * jnp.asarray(n_points_scale, self.dtype) * ref_wh * self.offset_scale
         # loc: (B, Q, H, L*P, 2) in [0, 1]
 
-        # Shared sampling core (spotter_tpu/ops/msda.py): XLA row-gathers by
-        # default, opt-in fused Pallas kernel via SPOTTER_TPU_MSDA.
+        # Shared sampling core (spotter_tpu/ops/msda.py): level-split one-hot
+        # Pallas kernel on TPU, XLA row-gathers elsewhere (SPOTTER_TPU_MSDA).
         out = deformable_sampling(
             value, loc, attn, spatial_shapes, points, method=self.method
         )

@@ -286,6 +286,11 @@ register(
 register(ModelFamily(name="owlvit", matches=("owlvit", "owl-vit", "owl_vit"), build=_build_owlvit))
 register(ModelFamily(name="yolos", matches=("yolos",), build=_build_yolos))
 register(
-    # plain DETR; matched AFTER rtdetr so "rtdetr*" names never land here
-    ModelFamily(name="detr", matches=("detr-resnet", "detr_resnet"), build=_build_detr)
+    # plain DETR (+ Table-Transformer, a pre-norm DETR with identical keys);
+    # matched AFTER rtdetr so "rtdetr*" names never land here
+    ModelFamily(
+        name="detr",
+        matches=("detr-resnet", "detr_resnet", "table-transformer", "table_transformer"),
+        build=_build_detr,
+    )
 )

@@ -173,14 +173,9 @@ def test_pallas_compiled_on_tpu(backend):
 
 def test_backend_policy(monkeypatch):
     monkeypatch.delenv(MSDA_ENV, raising=False)
-    # auto: shape-aware on TPU (xla below the gather cliff, one-hot kernel
-    # above), always XLA on CPU/GPU
-    if jax.default_backend() == "tpu":
-        assert msda_backend(batch_heads=64) == "xla"
-        assert msda_backend(batch_heads=128) == "pallas"
-    else:
-        assert msda_backend(batch_heads=128) == "xla"
-    assert msda_backend() == "xla"
+    # auto: level-split one-hot kernel on TPU, XLA row-gathers on CPU/GPU
+    expected = "pallas" if jax.default_backend() == "tpu" else "xla"
+    assert msda_backend() == expected
     monkeypatch.setenv(MSDA_ENV, "pallas")
     assert msda_backend() == "pallas"
     assert msda_backend("xla") == "xla"

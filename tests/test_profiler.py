@@ -31,13 +31,6 @@ def test_capture_writes_trace(tmp_path):
     assert glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
 
 
-def test_trace_context(tmp_path):
-    log_dir = str(tmp_path / "ctx")
-    with profiler.trace(log_dir):
-        _ = jnp.ones((64, 64)) @ jnp.ones((64, 64))
-    assert glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
-
-
 def test_capture_rejects_bad_duration(tmp_path):
     # would otherwise wedge the process-wide profiler (start without stop)
     with pytest.raises(ValueError):
