@@ -41,16 +41,25 @@ Differentiation: both Pallas kernels carry a custom VJP whose backward
 recomputes through the pure-jnp XLA reference — exactly differentiable, so
 the train step works with kernels enabled.
 
-The kernel is invoked once per feature level (level-split): a sample only
-ever lands inside its own level's span of the flat source, so comparing it
-against other levels' positions is pure waste — the stride-8 level holds
-~76% of positions but only 1/3 of samples, and the split cuts compares ~3x.
+Two sparsity layers cut the compare cost:
 
-Measured on v5e (R101, 640x640, clean chip, full model forward): the
-level-split kernel wins at every size — batch 8: 71.2 ms vs 77.7 XLA
-row-gathers; batch 16: 145.2 ms vs 500.6 (XLA's gather lowering collapses
-above batch*heads ~96). The dense (unsplit) kernel loses at batch 8
-(109.9 ms), which is why the split matters.
+- Level-split: the kernel runs once per feature level — a sample only ever
+  lands inside its own level's span of the flat source, so comparing it
+  against other levels' positions is pure waste (the stride-8 level holds
+  ~76% of positions but only 1/3 of samples; ~3x fewer compares).
+- Block-sparse: queries are sorted by quantized mean sample location
+  (y-major, matching the row-major source so source tiles are horizontal
+  bands), and a per-(query-tile, source-tile) hit table — scalar-prefetched
+  into SMEM — lets the kernel skip pairs no sample touches. Sampling
+  offsets cluster around each query's reference box, so sorted neighbors
+  touch few bands. The sort/unsort are two tiny Q-row permutes in XLA; the
+  mask provably never suppresses a hit (built from idx where w > 0).
+
+Measured on v5e (R101, 640x640, clean chip, full model forward, batch
+8 / 16): XLA row-gathers 77.7 / 500.6 ms (the gather lowering collapses
+above batch*heads ~96); dense one-hot 109.9 / 228.9; level-split 71.2 /
+145.2; level-split + block-sparse (production) 63.2 / 137.9 — every
+formulation parity-tested against the gather reference.
 
 Backend policy: `SPOTTER_TPU_MSDA` = auto (pallas on TPU, xla elsewhere) |
 xla | pallas | pallas_gather.
@@ -276,7 +285,6 @@ pallas_deformable_sampling.defvjp(_msda_fwd, _msda_bwd)
 # --- gather-free one-hot MXU kernel (the production TPU backend) ---
 
 S_TILE = 384  # three 128-lane vregs per one-hot tile column block
-Q_ALIGN = 8  # fp32 sublane granularity
 
 
 def _onehot_ref_math(rows, idx, w):
@@ -292,87 +300,113 @@ def _onehot_ref_math(rows, idx, w):
     return (g.astype(jnp.float32) * w[..., None].astype(jnp.float32)).sum(axis=2)
 
 
-def _onehot_kernel(idx_ref, w_ref, v_ref, out_ref, *, s_tile: int):
-    # idx/w: (1, Qp, JC); v: (1, s_tile, hd); out: (1, Qp, hd), accumulated
-    # across the s grid dimension (output revisiting).
-    qp, jc = idx_ref.shape[1], idx_ref.shape[2]
-    s_off = pl.program_id(1) * s_tile
-    col = jax.lax.broadcasted_iota(jnp.int32, (qp, s_tile), 1) + s_off
-    oh = jnp.zeros((qp, s_tile), jnp.float32)
-    idx = idx_ref[0]
-    w = w_ref[0]
-    for j in range(jc):  # unrolled: one compare+select per sample/corner
-        oh = oh + jnp.where(
-            col == idx[:, j : j + 1], w[:, j : j + 1].astype(jnp.float32), 0.0
+# --- block-sparse kernel: skip (query-tile, source-tile) pairs no sample
+# hits. Queries are pre-sorted by spatial locality (dispatcher), so a tile
+# of neighboring queries samples a narrow band of each level's source and
+# most pairs are misses — the compare cost drops by the miss rate.
+
+Q_TILE = 64
+
+
+def _onehot_sparse_kernel(mask_ref, idx_ref, w_ref, v_ref, out_ref, *, s_tile: int):
+    # mask_ref is the scalar-prefetch (SMEM) hit table, indexed by grid ids
+    qt, jc = idx_ref.shape[1], idx_ref.shape[2]
+    i, nq, ns = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ns == 0)
+    def _():
+        out_ref[0] = jnp.zeros_like(out_ref[0])
+
+    @pl.when(mask_ref[i, nq, ns] != 0)
+    def _():
+        s_off = ns * s_tile
+        col = jax.lax.broadcasted_iota(jnp.int32, (qt, s_tile), 1) + s_off
+        oh = jnp.zeros((qt, s_tile), jnp.float32)
+        idx = idx_ref[0]
+        w = w_ref[0]
+        for j in range(jc):
+            oh = oh + jnp.where(
+                col == idx[:, j : j + 1], w[:, j : j + 1].astype(jnp.float32), 0.0
+            )
+        acc = jnp.dot(
+            oh,
+            v_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
-    acc = jnp.dot(
-        oh,
-        v_ref[0].astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-        # full fp32 passes: with the default (bf16-pass) MXU precision the
-        # sampled values drift ~1e-2 from the exact gather, visible against
-        # the ±1 px golden-box budget
-        precision=jax.lax.Precision.HIGHEST,
-    )
-
-    @pl.when(pl.program_id(1) == 0)
-    def _():
-        out_ref[0] = acc.astype(out_ref.dtype)
-
-    @pl.when(pl.program_id(1) != 0)
-    def _():
         out_ref[0] = out_ref[0] + acc.astype(out_ref.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def pallas_onehot_sampling(rows, idx, w, interpret: bool = False):
-    """Gather-free MSDA aggregation: one-hot tiles x value tiles on the MXU.
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def pallas_onehot_sampling_sparse(rows, idx, w, mask, interpret: bool = False):
+    """Block-sparse one-hot sampling.
 
-    rows: (BH, S_pad, hd) value rows, S_pad a multiple of S_TILE;
-    idx/w: (BH, Qp, JC) per-query sample indices/folded weights, Qp a
-    multiple of Q_ALIGN, JC = 4 corners x L*P points. Returns (BH, Qp, hd).
+    rows: (BH, S_pad, hd); idx/w: (BH, Qp, JC) with Qp a multiple of
+    Q_TILE; mask: (BH, Qp // Q_TILE, S_pad // S_TILE) int32 — nonzero where
+    any sample of the query tile lands in the source tile (must never
+    suppress a real hit; the dispatcher derives it from idx where w > 0).
+    Returns (BH, Qp, hd) fp32.
     """
     bh, s_pad, hd = rows.shape
     _, qp, jc = idx.shape
     n_s = s_pad // S_TILE
-    kernel = partial(_onehot_kernel, s_tile=S_TILE)
+    n_qt = qp // Q_TILE
+    kernel = partial(_onehot_sparse_kernel, s_tile=S_TILE)
+    # upper bound: the mask is runtime data, so masked-off tiles can't be
+    # subtracted statically; the true cost is this times the hit fraction
     flops = 2 * bh * n_s * (qp * S_TILE * hd + jc * qp * S_TILE)
-    # fp32 output even for bf16 rows: partial sums accumulate across ~S/384
-    # tiles via output revisiting, and a bf16 round per tile-add would throw
-    # away the precision the HIGHEST-precision dot pays for
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, qp, hd), jnp.float32),
-        grid=(bh, n_s),
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # the hit table rides in SMEM
+        grid=(bh, n_qt, n_s),
         in_specs=[
-            pl.BlockSpec((1, qp, jc), lambda i, s: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, qp, jc), lambda i, s: (i, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(
-                (1, S_TILE, hd), lambda i, s: (i, s, 0), memory_space=pltpu.VMEM
+                (1, Q_TILE, jc), lambda i, nq, s, *_: (i, nq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, Q_TILE, jc), lambda i, nq, s, *_: (i, nq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, S_TILE, hd), lambda i, nq, s, *_: (i, s, 0),
+                memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, qp, hd), lambda i, s: (i, 0, 0), memory_space=pltpu.VMEM
+            (1, Q_TILE, hd), lambda i, nq, s, *_: (i, nq, 0),
+            memory_space=pltpu.VMEM,
         ),
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, qp, hd), jnp.float32),
+        grid_spec=grid_spec,
         cost_estimate=pl.CostEstimate(
-            flops=flops, bytes_accessed=rows.size * 4 + 2 * idx.size * 4, transcendentals=0
+            flops=flops,
+            bytes_accessed=rows.size * 4 + 2 * idx.size * 4 + mask.size * 4,
+            transcendentals=0,
         ),
         interpret=interpret,
-    )(idx, w, rows)
+    )(mask, idx, w, rows)
 
 
-def _onehot_fwd(rows, idx, w, interpret):
-    return pallas_onehot_sampling(rows, idx, w, interpret), (rows, idx, w)
+def _onehot_sparse_fwd(rows, idx, w, mask, interpret):
+    return (
+        pallas_onehot_sampling_sparse(rows, idx, w, mask, interpret),
+        (rows, idx, w),
+    )
 
 
-def _onehot_bwd(interpret, res, g):
+def _onehot_sparse_bwd(interpret, res, g):
+    # the mask never suppresses a real hit, so the dense reference computes
+    # the identical primal — its VJP is exact for the sparse kernel too
     rows, idx, w = res
     _, vjp = jax.vjp(lambda r, ww: _onehot_ref_math(r, idx, ww), rows, w)
     d_rows, d_w = vjp(g)
-    return d_rows, None, d_w
+    return d_rows, None, d_w, None
 
 
-pallas_onehot_sampling.defvjp(_onehot_fwd, _onehot_bwd)
+pallas_onehot_sampling_sparse.defvjp(_onehot_sparse_fwd, _onehot_sparse_bwd)
 
 
 def deformable_sampling(
@@ -409,24 +443,37 @@ def deformable_sampling(
         # kernel call compares its 4*P sample columns against that level's
         # positions only — a ~3x compare reduction vs one dense call (the
         # stride-8 level holds ~76% of positions but only 1/3 of samples).
+        # Block-sparsity on top: queries sorted by spatial locality so a
+        # Q_TILE of neighbors samples a narrow band of each level, and the
+        # kernel skips (query-tile, source-tile) pairs with no hit.
         jc = 4 * lp
-        qp = -(-q // Q_ALIGN) * Q_ALIGN
-        idx_q = (
-            idx.reshape(b, h_axis, 4, lp, q)
-            .transpose(0, 1, 4, 2, 3)
-            .reshape(b * h_axis, q, jc)
+        qp = -(-q // Q_TILE) * Q_TILE
+
+        # locality sort key: quantized mean sample position, y-major (the
+        # flat source is row-major, so source tiles are horizontal bands)
+        mean_xy = loc.mean(axis=(2, 3))  # (B, Q, 2) in [0, 1]
+        key = (
+            jnp.clip((mean_xy[..., 1] * 64).astype(jnp.int32), 0, 63) * 64
+            + jnp.clip((mean_xy[..., 0] * 64).astype(jnp.int32), 0, 63)
         )
-        w_q = (
-            w.reshape(b, h_axis, 4, lp, q)
-            .transpose(0, 1, 4, 2, 3)
-            .reshape(b * h_axis, q, jc)
+        perm = jnp.argsort(key, axis=1)  # (B, Q)
+        inv_perm = jnp.argsort(perm, axis=1)
+
+        idx_q = idx.reshape(b, h_axis, 4, lp, q).transpose(0, 1, 4, 2, 3)
+        w_q = w.reshape(b, h_axis, 4, lp, q).transpose(0, 1, 4, 2, 3)
+        psel = perm[:, None, :, None, None]
+        idx_q = jnp.take_along_axis(idx_q, psel, axis=2).reshape(
+            b * h_axis, q, jc
         )
-        if qp != q:  # padded queries: idx 0, weight 0 -> zero rows
+        w_q = jnp.take_along_axis(w_q, psel, axis=2).reshape(b * h_axis, q, jc)
+        if qp != q:  # padded queries: idx 0, weight 0 -> zero rows, no hits
             idx_q = jnp.pad(idx_q, ((0, 0), (0, qp - q), (0, 0)))
             w_q = jnp.pad(w_q, ((0, 0), (0, qp - q), (0, 0)))
+
         rows_all = value.transpose(0, 2, 1, 3).reshape(b * h_axis, s, hd)
         offs = _level_offsets(spatial_shapes)
         points = lp // len(spatial_shapes)
+        n_qt = qp // Q_TILE
         out = None
         for lvl, (lh, lw) in enumerate(spatial_shapes):
             s_l = lh * lw
@@ -441,9 +488,19 @@ def deformable_sampling(
             # may go negative here — they simply never match a column
             idx_l = idx_q[:, :, cols] - np.int32(offs[lvl])
             w_l = w_q[:, :, cols]
-            part = pallas_onehot_sampling(rows_l, idx_l, w_l, interp)
+            # hit mask: which source tiles does each query tile touch?
+            n_s = s_pad // S_TILE
+            tile_of = jnp.where(w_l > 0, idx_l // S_TILE, -1)  # (BH, Qp, JCl)
+            hits = tile_of[..., None] == jnp.arange(n_s, dtype=jnp.int32)
+            mask = (
+                hits.reshape(b * h_axis, n_qt, Q_TILE, len(cols), n_s)
+                .any(axis=(2, 3))
+                .astype(jnp.int32)
+            )
+            part = pallas_onehot_sampling_sparse(rows_l, idx_l, w_l, mask, interp)
             out = part if out is None else out + part
         out = out[:, :q].reshape(b, h_axis, q, hd)
+        out = jnp.take_along_axis(out, inv_perm[:, None, :, None], axis=2)
         return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)
     if chosen == "pallas_gather":
         vt = value.transpose(0, 2, 3, 1)  # (B, H, hd, S): spatial on lanes
