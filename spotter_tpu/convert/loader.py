@@ -16,6 +16,7 @@ from pathlib import Path
 import numpy as np
 
 from spotter_tpu.models.configs import (
+    ConditionalDetrConfig,
     DetrConfig,
     OwlViTConfig,
     RTDetrConfig,
@@ -143,6 +144,33 @@ def load_detr_from_hf(model_name: str) -> tuple[DetrConfig, dict]:
         model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
     naming = "timm" if hf_cfg.use_timm_backbone else "hf"
     params = convert_state_dict(model.state_dict(), detr_rules(cfg, naming), strict=True)
+    _save_cache(_cache_path(model_name), cfg, params)
+    return cfg, params
+
+
+def load_conditional_detr_from_hf(
+    model_name: str,
+) -> tuple[ConditionalDetrConfig, dict]:
+    """Load + convert a Conditional-DETR checkpoint; Orbax-cached."""
+    cached = _load_cache(_cache_path(model_name), ConditionalDetrConfig)
+    if cached is not None:
+        logger.info("Loaded converted config+params for %s from cache", model_name)
+        return cached
+
+    import torch
+    from transformers import AutoConfig, AutoModelForObjectDetection
+
+    from spotter_tpu.convert.conditional_detr_rules import conditional_detr_rules
+    from spotter_tpu.convert.torch_to_jax import convert_state_dict
+
+    hf_cfg = AutoConfig.from_pretrained(model_name)
+    cfg = ConditionalDetrConfig.from_hf(hf_cfg)
+    with torch.no_grad():
+        model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
+    naming = "timm" if hf_cfg.use_timm_backbone else "hf"
+    params = convert_state_dict(
+        model.state_dict(), conditional_detr_rules(cfg, naming), strict=True
+    )
     _save_cache(_cache_path(model_name), cfg, params)
     return cfg, params
 

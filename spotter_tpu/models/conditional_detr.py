@@ -1,0 +1,217 @@
+"""Flax Conditional-DETR detector (microsoft/conditional-detr-resnet-*).
+
+Served through the reference's `MODEL_NAME` AutoModel boundary
+(serve.py:199-205) like the other families. Architecture follows HF
+modeling_conditional_detr.py: a DETR encoder over backbone features plus a
+decoder whose cross-attention decouples *content* from *spatial* matching —
+each query carries a sine embedding of its predicted reference point,
+concatenated per-head with the content features, so q/k live in 2*d_model
+while values stay d_model. Boxes are regressed relative to the reference
+points (inverse-sigmoid add), and classification is focal-style (no
+"no-object" class) — postprocess is the same sigmoid top-k as RT-DETR.
+
+TPU-first notes: static shapes throughout; the per-layer `is_first`
+branching of the torch code (ca_qpos_proj exists only on layer 0) becomes a
+static Python conditional at trace time; all sine tables are computed in jnp
+from traced reference points (they depend on data, unlike DETR's static
+grid).
+"""
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from spotter_tpu.models.configs import ConditionalDetrConfig
+from spotter_tpu.models.detr import (
+    DetrEncoderLayer,
+    nearest_downsample_mask,
+    sine_position_from_mask,
+)
+from spotter_tpu.models.layers import MLPHead, get_activation, inverse_sigmoid
+from spotter_tpu.models.resnet import ResNetBackbone
+
+
+def query_sine_embedding(pos: jnp.ndarray, d_model: int) -> jnp.ndarray:
+    """Sine embedding of normalized (x, y) points, (B, Q, d_model).
+
+    Matches gen_sine_position_embeddings (modeling_conditional_detr.py:422):
+    scale 2*pi, half the channels for y then x, interleaved sin/cos.
+    """
+    dim = d_model // 2
+    dim_t = 10000.0 ** (2 * (np.arange(dim, dtype=np.float32) // 2) / dim)
+    x = pos[..., 0:1] * (2 * math.pi) / dim_t
+    y = pos[..., 1:2] * (2 * math.pi) / dim_t
+
+    def interleave(p):
+        return jnp.stack([jnp.sin(p[..., 0::2]), jnp.cos(p[..., 1::2])], axis=-1).reshape(
+            *p.shape[:-1], -1
+        )
+
+    return jnp.concatenate([interleave(y), interleave(x)], axis=-1)
+
+
+def _attend(q, k, v, num_heads, attn_mask, dtype):
+    """Scaled-dot attention over pre-projected q/k/v with per-head split.
+
+    q/k may be wider than v (Conditional-DETR's concatenated cross-attn);
+    the softmax runs fp32 like the rest of the stack.
+    """
+    b, tq, qk_dim = q.shape
+    head = qk_dim // num_heads
+    v_head = v.shape[-1] // num_heads
+    qh = q.reshape(b, tq, num_heads, head) * (head**-0.5)
+    kh = k.reshape(b, -1, num_heads, head)
+    vh = v.reshape(b, -1, num_heads, v_head)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh)
+    if attn_mask is not None:
+        logits = logits + attn_mask.astype(logits.dtype)
+    weights = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights, vh)
+    return out.reshape(b, tq, num_heads * v_head)
+
+
+class ConditionalDecoderLayer(nn.Module):
+    config: ConditionalDetrConfig
+    is_first: bool
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden: jnp.ndarray,  # (B, Q, D)
+        query_pos: jnp.ndarray,  # (B, Q, D)
+        query_sine: jnp.ndarray,  # (B, Q, D) transformed sine embedding
+        memory: jnp.ndarray,  # (B, S, D)
+        memory_pos: jnp.ndarray,  # (B, S, D)
+        memory_mask: Optional[jnp.ndarray],
+    ) -> jnp.ndarray:
+        cfg = self.config
+        d, heads = cfg.d_model, cfg.decoder_attention_heads
+        dense = lambda name: nn.Dense(d, dtype=self.dtype, name=name)
+
+        # self-attention: decoupled content/position projections
+        q = dense("sa_qcontent_proj")(hidden) + dense("sa_qpos_proj")(query_pos)
+        k = dense("sa_kcontent_proj")(hidden) + dense("sa_kpos_proj")(query_pos)
+        v = dense("sa_v_proj")(hidden)
+        attn = _attend(q, k, v, heads, None, self.dtype)
+        attn = dense("self_attn_out_proj")(attn)
+        hidden = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="self_attn_layer_norm"
+        )(hidden + attn)
+
+        # cross-attention: per-head concat of content and spatial halves
+        qc = dense("ca_qcontent_proj")(hidden)
+        kc = dense("ca_kcontent_proj")(memory)
+        v = dense("ca_v_proj")(memory)
+        kpos = dense("ca_kpos_proj")(memory_pos)
+        if self.is_first:  # ca_qpos_proj exists only on the first layer
+            qc = qc + dense("ca_qpos_proj")(query_pos)
+            kc = kc + kpos
+        qsine = dense("ca_qpos_sine_proj")(query_sine)
+
+        b, nq, _ = qc.shape
+        s = kc.shape[1]
+        head = d // heads
+        q2 = jnp.concatenate(
+            [qc.reshape(b, nq, heads, head), qsine.reshape(b, nq, heads, head)], axis=-1
+        ).reshape(b, nq, 2 * d)
+        k2 = jnp.concatenate(
+            [kc.reshape(b, s, heads, head), kpos.reshape(b, s, heads, head)], axis=-1
+        ).reshape(b, s, 2 * d)
+        cross = _attend(q2, k2, v, heads, memory_mask, self.dtype)
+        cross = dense("encoder_attn_out_proj")(cross)
+        hidden = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="encoder_attn_layer_norm"
+        )(hidden + cross)
+
+        ffn = nn.Dense(cfg.decoder_ffn_dim, dtype=self.dtype, name="fc1")(hidden)
+        ffn = get_activation(cfg.activation_function)(ffn)
+        ffn = nn.Dense(d, dtype=self.dtype, name="fc2")(ffn)
+        return nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm"
+        )(hidden + ffn)
+
+
+class ConditionalDetrDetector(nn.Module):
+    """Conditional DETR: pixels (+mask) -> {"logits" (B,Q,C), "pred_boxes"}."""
+
+    config: ConditionalDetrConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, pixel_values: jnp.ndarray, pixel_mask: Optional[jnp.ndarray] = None
+    ) -> dict:
+        cfg = self.config
+        b, h, w, _ = pixel_values.shape
+        if pixel_mask is None:
+            pixel_mask = jnp.ones((b, h, w), dtype=jnp.float32)
+
+        features = ResNetBackbone(cfg.backbone, dtype=self.dtype, name="backbone")(
+            pixel_values
+        )
+        feat = features[-1]
+        _, fh, fw, _ = feat.shape
+        mask = nearest_downsample_mask(pixel_mask, (fh, fw))
+
+        pos = sine_position_from_mask(
+            mask, cfg.d_model // 2, cfg.positional_encoding_temperature
+        ).astype(self.dtype)
+        src = nn.Conv(
+            cfg.d_model, (1, 1), use_bias=True, dtype=self.dtype, name="input_projection"
+        )(feat)
+        src = src.reshape(b, fh * fw, cfg.d_model)
+        pos = pos.reshape(b, fh * fw, cfg.d_model)
+        mask_flat = mask.reshape(b, fh * fw)
+        attn_mask = jnp.where(
+            mask_flat[:, None, None, :] > 0, 0.0, jnp.finfo(jnp.float32).min
+        )
+
+        for i in range(cfg.encoder_layers):
+            src = DetrEncoderLayer(cfg, dtype=self.dtype, name=f"encoder_layer{i}")(
+                src, pos, attn_mask
+            )
+
+        query_pos = self.param(
+            "query_pos",
+            nn.initializers.normal(1.0),
+            (cfg.num_queries, cfg.d_model),
+            jnp.float32,
+        )
+        query_pos = jnp.broadcast_to(
+            query_pos[None].astype(self.dtype), (b, cfg.num_queries, cfg.d_model)
+        )
+
+        # reference points from the query embeddings (shared by all layers)
+        ref_logits = MLPHead(cfg.d_model, 2, 2, dtype=self.dtype, name="ref_point_head")(
+            query_pos
+        ).astype(jnp.float32)
+        ref = nn.sigmoid(ref_logits)  # (B, Q, 2) normalized centers
+        sine_base = query_sine_embedding(ref, cfg.d_model).astype(self.dtype)
+
+        query_scale = MLPHead(
+            cfg.d_model, cfg.d_model, 2, dtype=self.dtype, name="query_scale"
+        )
+        hidden = jnp.zeros_like(query_pos)
+        for i in range(cfg.decoder_layers):
+            scale = 1.0 if i == 0 else query_scale(hidden)
+            hidden = ConditionalDecoderLayer(
+                cfg, is_first=(i == 0), dtype=self.dtype, name=f"decoder_layer{i}"
+            )(hidden, query_pos, sine_base * scale, src, pos, attn_mask)
+        hidden = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="decoder_layernorm"
+        )(hidden)
+
+        logits = nn.Dense(
+            cfg.num_labels, dtype=self.dtype, name="class_labels_classifier"
+        )(hidden)
+        # box regression relative to the reference point (x, y only)
+        delta = MLPHead(cfg.d_model, 4, 3, dtype=self.dtype, name="bbox_predictor")(
+            hidden
+        ).astype(jnp.float32)
+        delta = delta.at[..., :2].add(inverse_sigmoid(ref))
+        boxes = nn.sigmoid(delta)
+        return {"logits": logits.astype(jnp.float32), "pred_boxes": boxes}

@@ -159,24 +159,7 @@ class DetrConfig:
     @classmethod
     def from_hf(cls, hf) -> "DetrConfig":
         if hf.use_timm_backbone:
-            # timm checkpoints: facebook/detr-resnet-50/101 (bottleneck) and
-            # microsoft/table-transformer-* (resnet18, basic blocks); the
-            # architecture comes from the backbone name
-            timm_presets = {
-                "resnet18": dict(
-                    layer_type="basic", depths=(2, 2, 2, 2),
-                    hidden_sizes=(64, 128, 256, 512),
-                ),
-                "resnet34": dict(
-                    layer_type="basic", depths=(3, 4, 6, 3),
-                    hidden_sizes=(64, 128, 256, 512),
-                ),
-                "resnet50": dict(depths=(3, 4, 6, 3)),
-                "resnet101": dict(depths=(3, 4, 23, 3)),
-            }
-            backbone = ResNetConfig(
-                style="v1", out_indices=(4,), **timm_presets[hf.backbone]
-            )
+            backbone = timm_resnet_backbone(hf.backbone)
         else:
             backbone = replace(
                 ResNetConfig.from_hf(hf.backbone_config),
@@ -197,6 +180,87 @@ class DetrConfig:
             pre_norm=hf.model_type == "table-transformer",
             id2label=tuple(sorted((int(k), v) for k, v in hf.id2label.items())),
         )
+
+
+@dataclass(frozen=True)
+class ConditionalDetrConfig:
+    """Conditional DETR (microsoft/conditional-detr-resnet-*).
+
+    DETR-shaped encoder plus the conditional decoder (content/spatial
+    decoupled cross-attention, reference-point box regression, focal
+    classification without a "no-object" class). Mirrors HF
+    ConditionalDetrConfig (configuration_conditional_detr.py).
+    """
+
+    backbone: "ResNetConfig" = field(
+        default_factory=lambda: ResNetConfig(style="v1", out_indices=(4,))
+    )
+    num_labels: int = 91
+    d_model: int = 256
+    num_queries: int = 300
+    encoder_layers: int = 6
+    decoder_layers: int = 6
+    encoder_attention_heads: int = 8
+    decoder_attention_heads: int = 8
+    encoder_ffn_dim: int = 2048
+    decoder_ffn_dim: int = 2048
+    activation_function: str = "relu"
+    positional_encoding_temperature: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    pre_norm: bool = False  # encoder layers are shared with DETR's post-norm
+    id2label: tuple[tuple[int, str], ...] = ()
+
+    @property
+    def id2label_dict(self) -> dict[int, str]:
+        return dict(self.id2label)
+
+    @classmethod
+    def from_hf(cls, hf) -> "ConditionalDetrConfig":
+        if hf.use_timm_backbone:
+            backbone = timm_resnet_backbone(hf.backbone)
+        else:
+            backbone = replace(
+                ResNetConfig.from_hf(hf.backbone_config),
+                out_indices=(len(hf.backbone_config.depths),),
+            )
+        return cls(
+            backbone=backbone,
+            num_labels=hf.num_labels,
+            d_model=hf.d_model,
+            num_queries=hf.num_queries,
+            encoder_layers=hf.encoder_layers,
+            decoder_layers=hf.decoder_layers,
+            encoder_attention_heads=hf.encoder_attention_heads,
+            decoder_attention_heads=hf.decoder_attention_heads,
+            encoder_ffn_dim=hf.encoder_ffn_dim,
+            decoder_ffn_dim=hf.decoder_ffn_dim,
+            activation_function=hf.activation_function,
+            id2label=tuple(sorted((int(k), v) for k, v in hf.id2label.items())),
+        )
+
+
+# timm checkpoints name their backbone: facebook/detr-resnet-50/101 and
+# microsoft/conditional-detr-resnet-* (bottleneck), microsoft/
+# table-transformer-* (resnet18, basic blocks). One table shared by every
+# DETR-lineage from_hf so new backbones are added in one place.
+_TIMM_RESNET_PRESETS = {
+    "resnet18": dict(
+        layer_type="basic", depths=(2, 2, 2, 2), hidden_sizes=(64, 128, 256, 512)
+    ),
+    "resnet34": dict(
+        layer_type="basic", depths=(3, 4, 6, 3), hidden_sizes=(64, 128, 256, 512)
+    ),
+    "resnet50": dict(depths=(3, 4, 6, 3)),
+    "resnet101": dict(depths=(3, 4, 23, 3)),
+}
+
+
+def timm_resnet_backbone(name: str) -> ResNetConfig:
+    if name not in _TIMM_RESNET_PRESETS:
+        raise ValueError(
+            f"Unsupported timm backbone {name!r}; known: {sorted(_TIMM_RESNET_PRESETS)}"
+        )
+    return ResNetConfig(style="v1", out_indices=(4,), **_TIMM_RESNET_PRESETS[name])
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,100 @@
+"""Numerical parity: Flax ConditionalDetrDetector vs HF torch
+ConditionalDetrForObjectDetection. Tiny random-init config, no network —
+same guarantee pattern as the other families."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import ConditionalDetrConfig as HFConditionalDetrConfig
+from transformers import ResNetConfig as HFResNetConfig
+from transformers.models.conditional_detr.modeling_conditional_detr import (
+    ConditionalDetrForObjectDetection,
+)
+
+from spotter_tpu.convert.conditional_detr_rules import conditional_detr_rules
+from spotter_tpu.convert.torch_to_jax import convert_state_dict
+from spotter_tpu.models.conditional_detr import ConditionalDetrDetector
+from spotter_tpu.models.configs import ConditionalDetrConfig
+
+
+def _tiny_hf_config():
+    backbone = HFResNetConfig(
+        embedding_size=8,
+        hidden_sizes=[8, 12, 16, 24],
+        depths=[1, 1, 1, 1],
+        layer_type="basic",
+        out_features=["stage4"],
+    )
+    return HFConditionalDetrConfig(
+        use_timm_backbone=False,
+        use_pretrained_backbone=False,
+        backbone=None,  # the class defaults to backbone="resnet50"
+        backbone_config=backbone,
+        d_model=32,
+        encoder_layers=2,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=48,
+        decoder_ffn_dim=48,
+        num_queries=9,
+        num_labels=7,
+    )
+
+
+def test_registry_routes_conditional_before_plain_detr():
+    """'microsoft/conditional-detr-resnet-50' contains the plain-DETR match
+    substring 'detr-resnet' — the conditional family must win, which relies
+    on registration order. Pin it so a reorder can't silently route the
+    name to the wrong architecture."""
+    import os
+
+    os.environ.setdefault("SPOTTER_TPU_TINY", "1")
+    from spotter_tpu.models import build_detector
+    from spotter_tpu.models.conditional_detr import ConditionalDetrDetector
+    from spotter_tpu.models.detr import DetrDetector
+
+    built = build_detector("microsoft/conditional-detr-resnet-50")
+    assert isinstance(built.module, ConditionalDetrDetector)
+    assert built.postprocess == "sigmoid_topk" and built.needs_mask
+    plain = build_detector("facebook/detr-resnet-50")
+    assert isinstance(plain.module, DetrDetector)
+
+
+def test_conditional_detr_parity():
+    hf_cfg = _tiny_hf_config()
+    torch.manual_seed(0)
+    model = ConditionalDetrForObjectDetection(hf_cfg).eval()
+    with torch.no_grad():
+        for m in model.modules():
+            if hasattr(m, "running_mean"):
+                m.running_mean.uniform_(-0.2, 0.2)
+                m.running_var.uniform_(0.8, 1.2)
+
+    cfg = ConditionalDetrConfig.from_hf(hf_cfg)
+    params = convert_state_dict(
+        model.state_dict(), conditional_detr_rules(cfg), strict=True
+    )
+
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, size=(2, 3, 64, 96)).astype(np.float32)
+    mask = np.zeros((2, 64, 96), dtype=np.int64)
+    mask[0, :64, :80] = 1
+    mask[1, :48, :96] = 1
+
+    with torch.no_grad():
+        tout = model(torch.from_numpy(x), pixel_mask=torch.from_numpy(mask))
+
+    jout = ConditionalDetrDetector(cfg).apply(
+        {"params": params},
+        np.transpose(x, (0, 2, 3, 1)),
+        mask.astype(np.float32),
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(jout["pred_boxes"]), tout.pred_boxes.numpy(), atol=2e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(jout["logits"]), tout.logits.numpy(), atol=5e-4, rtol=1e-3
+    )
