@@ -17,6 +17,7 @@ import numpy as np
 
 from spotter_tpu.models.configs import (
     ConditionalDetrConfig,
+    DeformableDetrConfig,
     DetrConfig,
     OwlViTConfig,
     RTDetrConfig,
@@ -125,54 +126,60 @@ def load_rtdetr_from_hf(model_name: str) -> tuple[RTDetrConfig, dict]:
     return cfg, params
 
 
-def load_detr_from_hf(model_name: str) -> tuple[DetrConfig, dict]:
-    """Load + convert a DETR checkpoint (timm- or HF-backbone serialization)."""
-    cached = _load_cache(_cache_path(model_name), DetrConfig)
+def _load_detr_lineage_from_hf(model_name: str, config_cls, rules_import: str):
+    """Shared loader for the DETR-lineage families (DETR/Table-Transformer,
+    Conditional-DETR, Deformable-DETR): AutoConfig -> config dataclass,
+    AutoModel state_dict -> rule-table conversion (timm- or HF-backbone
+    serialization), Orbax-cached per MODEL_NAME."""
+    cached = _load_cache(_cache_path(model_name), config_cls)
     if cached is not None:
         logger.info("Loaded converted config+params for %s from cache", model_name)
         return cached
 
+    import importlib
+
     import torch
     from transformers import AutoConfig, AutoModelForObjectDetection
 
-    from spotter_tpu.convert.detr_rules import detr_rules
     from spotter_tpu.convert.torch_to_jax import convert_state_dict
 
+    module_name, fn_name = rules_import.rsplit(".", 1)
+    rules_fn = getattr(importlib.import_module(module_name), fn_name)
+
     hf_cfg = AutoConfig.from_pretrained(model_name)
-    cfg = DetrConfig.from_hf(hf_cfg)
+    cfg = config_cls.from_hf(hf_cfg)
     with torch.no_grad():
         model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
     naming = "timm" if hf_cfg.use_timm_backbone else "hf"
-    params = convert_state_dict(model.state_dict(), detr_rules(cfg, naming), strict=True)
+    params = convert_state_dict(model.state_dict(), rules_fn(cfg, naming), strict=True)
     _save_cache(_cache_path(model_name), cfg, params)
     return cfg, params
+
+
+def load_detr_from_hf(model_name: str) -> tuple[DetrConfig, dict]:
+    return _load_detr_lineage_from_hf(
+        model_name, DetrConfig, "spotter_tpu.convert.detr_rules.detr_rules"
+    )
 
 
 def load_conditional_detr_from_hf(
     model_name: str,
 ) -> tuple[ConditionalDetrConfig, dict]:
-    """Load + convert a Conditional-DETR checkpoint; Orbax-cached."""
-    cached = _load_cache(_cache_path(model_name), ConditionalDetrConfig)
-    if cached is not None:
-        logger.info("Loaded converted config+params for %s from cache", model_name)
-        return cached
-
-    import torch
-    from transformers import AutoConfig, AutoModelForObjectDetection
-
-    from spotter_tpu.convert.conditional_detr_rules import conditional_detr_rules
-    from spotter_tpu.convert.torch_to_jax import convert_state_dict
-
-    hf_cfg = AutoConfig.from_pretrained(model_name)
-    cfg = ConditionalDetrConfig.from_hf(hf_cfg)
-    with torch.no_grad():
-        model = AutoModelForObjectDetection.from_pretrained(model_name).eval()
-    naming = "timm" if hf_cfg.use_timm_backbone else "hf"
-    params = convert_state_dict(
-        model.state_dict(), conditional_detr_rules(cfg, naming), strict=True
+    return _load_detr_lineage_from_hf(
+        model_name,
+        ConditionalDetrConfig,
+        "spotter_tpu.convert.conditional_detr_rules.conditional_detr_rules",
     )
-    _save_cache(_cache_path(model_name), cfg, params)
-    return cfg, params
+
+
+def load_deformable_detr_from_hf(
+    model_name: str,
+) -> tuple[DeformableDetrConfig, dict]:
+    return _load_detr_lineage_from_hf(
+        model_name,
+        DeformableDetrConfig,
+        "spotter_tpu.convert.deformable_detr_rules.deformable_detr_rules",
+    )
 
 
 def load_owlvit_from_hf(model_name: str) -> tuple[OwlViTConfig, dict]:

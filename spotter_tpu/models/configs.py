@@ -158,6 +158,7 @@ class DetrConfig:
 
     @classmethod
     def from_hf(cls, hf) -> "DetrConfig":
+        check_no_dilation(hf)
         if hf.use_timm_backbone:
             backbone = timm_resnet_backbone(hf.backbone)
         else:
@@ -216,6 +217,7 @@ class ConditionalDetrConfig:
 
     @classmethod
     def from_hf(cls, hf) -> "ConditionalDetrConfig":
+        check_no_dilation(hf)
         if hf.use_timm_backbone:
             backbone = timm_resnet_backbone(hf.backbone)
         else:
@@ -236,6 +238,16 @@ class ConditionalDetrConfig:
             decoder_ffn_dim=hf.decoder_ffn_dim,
             activation_function=hf.activation_function,
             id2label=tuple(sorted((int(k), v) for k, v in hf.id2label.items())),
+        )
+
+
+def check_no_dilation(hf) -> None:
+    """Reject dc5 checkpoints (timm `dilation=True` turns stage-4 stride into
+    dilation-2 convs, which our ResNet doesn't model — converting anyway would
+    produce a half-resolution final feature map and silently-garbage boxes)."""
+    if getattr(hf, "dilation", False):
+        raise ValueError(
+            "dilated (dc5) backbones are not supported; use the non-dc5 checkpoint"
         )
 
 
@@ -261,6 +273,82 @@ def timm_resnet_backbone(name: str) -> ResNetConfig:
             f"Unsupported timm backbone {name!r}; known: {sorted(_TIMM_RESNET_PRESETS)}"
         )
     return ResNetConfig(style="v1", out_indices=(4,), **_TIMM_RESNET_PRESETS[name])
+
+
+@dataclass(frozen=True)
+class DeformableDetrConfig:
+    """Deformable DETR (SenseTime/deformable-detr*) — multiscale deformable
+    attention in BOTH encoder and decoder, with the plain / with-box-refine /
+    two-stage variants. Mirrors HF DeformableDetrConfig
+    (configuration_deformable_detr.py); the reference serves this family
+    through the same AutoModel boundary (serve.py:199-205).
+    """
+
+    backbone: "ResNetConfig" = field(
+        default_factory=lambda: ResNetConfig(style="v1", out_indices=(2, 3, 4))
+    )
+    num_labels: int = 91
+    d_model: int = 256
+    num_queries: int = 300
+    encoder_layers: int = 6
+    decoder_layers: int = 6
+    encoder_attention_heads: int = 8
+    decoder_attention_heads: int = 8
+    encoder_ffn_dim: int = 1024
+    decoder_ffn_dim: int = 1024
+    activation_function: str = "relu"
+    num_feature_levels: int = 4
+    encoder_n_points: int = 4
+    decoder_n_points: int = 4
+    with_box_refine: bool = False
+    two_stage: bool = False
+    two_stage_num_proposals: int = 300
+    positional_encoding_temperature: float = 10000.0
+    layer_norm_eps: float = 1e-5  # torch nn.LayerNorm/GroupNorm default
+    id2label: tuple[tuple[int, str], ...] = ()
+
+    @property
+    def id2label_dict(self) -> dict[int, str]:
+        return dict(self.id2label)
+
+    @property
+    def num_pred_heads(self) -> int:
+        # two-stage keeps one extra head pair for scoring encoder proposals
+        return self.decoder_layers + (1 if self.two_stage else 0)
+
+    @classmethod
+    def from_hf(cls, hf) -> "DeformableDetrConfig":
+        if hf.position_embedding_type != "sine":
+            raise ValueError(
+                f"Unsupported position_embedding_type {hf.position_embedding_type!r}"
+            )
+        check_no_dilation(hf)
+        if hf.use_timm_backbone:
+            out_indices = (2, 3, 4) if hf.num_feature_levels > 1 else (4,)
+            backbone = replace(timm_resnet_backbone(hf.backbone), out_indices=out_indices)
+        else:
+            # the AutoBackbone path taps backbone_config.out_features as-is
+            backbone = ResNetConfig.from_hf(hf.backbone_config)
+        return cls(
+            backbone=backbone,
+            num_labels=hf.num_labels,
+            d_model=hf.d_model,
+            num_queries=hf.num_queries,
+            encoder_layers=hf.encoder_layers,
+            decoder_layers=hf.decoder_layers,
+            encoder_attention_heads=hf.encoder_attention_heads,
+            decoder_attention_heads=hf.decoder_attention_heads,
+            encoder_ffn_dim=hf.encoder_ffn_dim,
+            decoder_ffn_dim=hf.decoder_ffn_dim,
+            activation_function=hf.activation_function,
+            num_feature_levels=hf.num_feature_levels,
+            encoder_n_points=hf.encoder_n_points,
+            decoder_n_points=hf.decoder_n_points,
+            with_box_refine=hf.with_box_refine,
+            two_stage=hf.two_stage,
+            two_stage_num_proposals=hf.two_stage_num_proposals,
+            id2label=tuple(sorted((int(k), v) for k, v in hf.id2label.items())),
+        )
 
 
 @dataclass(frozen=True)

@@ -23,6 +23,7 @@ from spotter_tpu.models.coco import coco_id2label_80
 from spotter_tpu.models.configs import (
     ConditionalDetrConfig,
     RESNET_PRESETS,
+    DeformableDetrConfig,
     DetrConfig,
     OwlViTConfig,
     OwlViTTextConfig,
@@ -32,6 +33,7 @@ from spotter_tpu.models.configs import (
     YolosConfig,
 )
 from spotter_tpu.models.conditional_detr import ConditionalDetrDetector
+from spotter_tpu.models.deformable_detr import DeformableDetrDetector
 from spotter_tpu.models.detr import DetrDetector
 from spotter_tpu.models.owlvit import OwlViTDetector
 from spotter_tpu.models.yolos import YolosDetector
@@ -334,6 +336,60 @@ def _build_conditional_detr(model_name: str) -> BuiltDetector:
     )
 
 
+def tiny_deformable_detr_config(num_labels: int = 80) -> DeformableDetrConfig:
+    return DeformableDetrConfig(
+        backbone=ResNetConfig(
+            embedding_size=8, hidden_sizes=(8, 12, 16, 24), depths=(1, 1, 1, 1),
+            layer_type="basic", style="v1", out_indices=(2, 3, 4),
+        ),
+        num_labels=num_labels,
+        d_model=32,
+        num_queries=9,
+        encoder_layers=1,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=48,
+        decoder_ffn_dim=48,
+        encoder_n_points=2,
+        decoder_n_points=2,
+        with_box_refine=True,
+        id2label=tuple(coco_id2label_80().items()),
+    )
+
+
+def _build_deformable_detr(model_name: str) -> BuiltDetector:
+    if os.environ.get(TINY_ENV):
+        cfg = tiny_deformable_detr_config()
+        spec = PreprocessSpec(
+            mode="shortest_edge", size=(48, 64), mean=IMAGENET_MEAN, std=IMAGENET_STD,
+            pad_to=(64, 64),
+        )
+        module = DeformableDetrDetector(cfg, dtype=compute_dtype())
+        params = _init_random(module, spec.input_hw)
+        logger.info(
+            "Built tiny random Deformable-DETR for %s (%s)", model_name, TINY_ENV
+        )
+    else:
+        from spotter_tpu.convert.loader import (  # lazy: needs torch
+            load_deformable_detr_from_hf,
+        )
+
+        cfg, params = load_deformable_detr_from_hf(model_name)
+        spec = DETR_SPEC
+        module = DeformableDetrDetector(cfg, dtype=compute_dtype())
+    return BuiltDetector(
+        model_name=model_name,
+        module=module,
+        params=params,
+        preprocess_spec=spec,
+        postprocess="sigmoid_topk",  # focal head, NMS-free top-k (HF top_k=100)
+        id2label=cfg.id2label_dict,
+        num_top_queries=min(100, cfg.num_queries),
+        needs_mask=True,
+    )
+
+
 register(
     # must precede the plain-detr family: "conditional-detr-resnet-50"
     # also contains the "detr-resnet" substring
@@ -341,6 +397,13 @@ register(
         name="conditional_detr",
         matches=("conditional-detr", "conditional_detr"),
         build=_build_conditional_detr,
+    )
+)
+register(
+    ModelFamily(
+        name="deformable_detr",
+        matches=("deformable-detr", "deformable_detr"),
+        build=_build_deformable_detr,
     )
 )
 register(

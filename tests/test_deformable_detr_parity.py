@@ -1,0 +1,119 @@
+"""Numerical parity: Flax DeformableDetrDetector vs HF torch
+DeformableDetrForObjectDetection — tiny random-init configs, no network,
+covering all three published variants (plain / with-box-refine / two-stage)
+plus the single-scale config and the padded-pixel-mask path (valid ratios,
+per-level mask sine embeddings, masked MSDA values)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import DeformableDetrConfig as HFDeformableDetrConfig
+from transformers import ResNetConfig as HFResNetConfig
+from transformers.models.deformable_detr.modeling_deformable_detr import (
+    DeformableDetrForObjectDetection,
+)
+
+from spotter_tpu.convert.deformable_detr_rules import deformable_detr_rules
+from spotter_tpu.convert.torch_to_jax import convert_state_dict
+from spotter_tpu.models.configs import DeformableDetrConfig
+from spotter_tpu.models.deformable_detr import DeformableDetrDetector
+
+
+def _tiny_hf_config(num_feature_levels=4, with_box_refine=False, two_stage=False):
+    single = num_feature_levels == 1
+    backbone = HFResNetConfig(
+        embedding_size=8,
+        hidden_sizes=[8, 12, 16, 24],
+        depths=[1, 1, 1, 1],
+        layer_type="basic",
+        out_features=["stage4"] if single else ["stage2", "stage3", "stage4"],
+    )
+    return HFDeformableDetrConfig(
+        use_timm_backbone=False,
+        use_pretrained_backbone=False,
+        backbone=None,
+        backbone_config=backbone,
+        d_model=32,
+        encoder_layers=2,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=48,
+        decoder_ffn_dim=48,
+        encoder_n_points=3,
+        decoder_n_points=2,
+        num_feature_levels=num_feature_levels,
+        num_queries=11,
+        num_labels=7,
+        with_box_refine=with_box_refine,
+        two_stage=two_stage,
+        two_stage_num_proposals=9,
+        disable_custom_kernels=True,
+    )
+
+
+def _run_parity(hf_cfg, with_mask: bool):
+    torch.manual_seed(0)
+    model = DeformableDetrForObjectDetection(hf_cfg).eval()
+    with torch.no_grad():
+        for m in model.modules():
+            if hasattr(m, "running_mean"):
+                m.running_mean.uniform_(-0.2, 0.2)
+                m.running_var.uniform_(0.8, 1.2)
+
+    cfg = DeformableDetrConfig.from_hf(hf_cfg)
+    params = convert_state_dict(model.state_dict(), deformable_detr_rules(cfg), strict=True)
+
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, size=(2, 3, 64, 96)).astype(np.float32)
+    if with_mask:
+        # ragged valid regions exercise valid ratios + per-level mask sines
+        mask = np.zeros((2, 64, 96), dtype=np.int64)
+        mask[0, :64, :80] = 1
+        mask[1, :48, :96] = 1
+    else:
+        mask = np.ones((2, 64, 96), dtype=np.int64)
+
+    with torch.no_grad():
+        tout = model(torch.from_numpy(x), pixel_mask=torch.from_numpy(mask))
+
+    jout = DeformableDetrDetector(cfg).apply(
+        {"params": params},
+        np.transpose(x, (0, 2, 3, 1)),
+        mask.astype(np.float32) if with_mask else None,
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(jout["pred_boxes"]), tout.pred_boxes.numpy(), atol=5e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(jout["logits"]), tout.logits.numpy(), atol=1e-3, rtol=1e-3
+    )
+    if hf_cfg.two_stage:
+        np.testing.assert_allclose(
+            np.asarray(jout["enc_outputs_class"]),
+            tout.enc_outputs_class.numpy(),
+            atol=1e-3,
+            rtol=1e-3,
+        )
+
+
+@pytest.mark.parametrize(
+    "with_box_refine,two_stage",
+    [(False, False), (True, False), (True, True)],
+    ids=["plain", "box_refine", "two_stage"],
+)
+def test_deformable_detr_parity(with_box_refine, two_stage):
+    _run_parity(
+        _tiny_hf_config(with_box_refine=with_box_refine, two_stage=two_stage),
+        with_mask=False,
+    )
+
+
+def test_deformable_detr_parity_masked():
+    _run_parity(_tiny_hf_config(with_box_refine=True), with_mask=True)
+
+
+def test_deformable_detr_parity_single_scale():
+    _run_parity(_tiny_hf_config(num_feature_levels=1), with_mask=False)

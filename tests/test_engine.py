@@ -104,3 +104,23 @@ def test_owlvit_family_end_to_end(monkeypatch):
     assert len(results) == 2
     labels = {d["label"] for dets in results for d in dets}
     assert labels <= {"tv", "couch", "bed"} and labels
+
+
+def test_deformable_detr_family_end_to_end():
+    """Tiny Deformable-DETR through the full engine path (shortest-edge +
+    mask + sigmoid top-k)."""
+    built = build_detector("SenseTime/deformable-detr-with-box-refine")
+    assert built.postprocess == "sigmoid_topk" and built.needs_mask
+    eng = InferenceEngine(built, threshold=0.0, batch_buckets=(1, 2))
+    results = eng.detect(_imgs(3, hw=(40, 72)))
+    assert len(results) == 3
+    for dets in results:
+        assert all(set(d) == {"label", "score", "box"} for d in dets)
+
+
+def test_conditional_detr_registry_routing():
+    """'conditional-detr-resnet-50' contains the 'detr-resnet' substring; the
+    registry must route it to the conditional family (registration order)."""
+    built = build_detector("microsoft/conditional-detr-resnet-50")
+    assert built.postprocess == "sigmoid_topk"
+    assert type(built.module).__name__ == "ConditionalDetrDetector"
