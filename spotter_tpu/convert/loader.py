@@ -126,25 +126,20 @@ def load_rtdetr_from_hf(model_name: str) -> tuple[RTDetrConfig, dict]:
     return cfg, params
 
 
-def _load_detr_lineage_from_hf(model_name: str, config_cls, rules_import: str):
+def _load_detr_lineage_from_hf(model_name: str, config_cls, rules_fn):
     """Shared loader for the DETR-lineage families (DETR/Table-Transformer,
     Conditional-DETR, Deformable-DETR): AutoConfig -> config dataclass,
-    AutoModel state_dict -> rule-table conversion (timm- or HF-backbone
-    serialization), Orbax-cached per MODEL_NAME."""
+    AutoModel state_dict -> `rules_fn(cfg, naming)` rule-table conversion
+    (timm- or HF-backbone serialization), Orbax-cached per MODEL_NAME."""
     cached = _load_cache(_cache_path(model_name), config_cls)
     if cached is not None:
         logger.info("Loaded converted config+params for %s from cache", model_name)
         return cached
 
-    import importlib
-
     import torch
     from transformers import AutoConfig, AutoModelForObjectDetection
 
     from spotter_tpu.convert.torch_to_jax import convert_state_dict
-
-    module_name, fn_name = rules_import.rsplit(".", 1)
-    rules_fn = getattr(importlib.import_module(module_name), fn_name)
 
     hf_cfg = AutoConfig.from_pretrained(model_name)
     cfg = config_cls.from_hf(hf_cfg)
@@ -157,33 +152,33 @@ def _load_detr_lineage_from_hf(model_name: str, config_cls, rules_import: str):
 
 
 def load_detr_from_hf(model_name: str) -> tuple[DetrConfig, dict]:
-    return _load_detr_lineage_from_hf(
-        model_name, DetrConfig, "spotter_tpu.convert.detr_rules.detr_rules"
-    )
+    from spotter_tpu.convert.detr_rules import detr_rules
+
+    return _load_detr_lineage_from_hf(model_name, DetrConfig, detr_rules)
 
 
 def load_conditional_detr_from_hf(
     model_name: str,
 ) -> tuple[ConditionalDetrConfig, dict]:
+    from spotter_tpu.convert.conditional_detr_rules import conditional_detr_rules
+
     return _load_detr_lineage_from_hf(
-        model_name,
-        ConditionalDetrConfig,
-        "spotter_tpu.convert.conditional_detr_rules.conditional_detr_rules",
+        model_name, ConditionalDetrConfig, conditional_detr_rules
     )
 
 
 def load_deformable_detr_from_hf(
     model_name: str,
 ) -> tuple[DeformableDetrConfig, dict]:
+    from spotter_tpu.convert.deformable_detr_rules import deformable_detr_rules
+
     return _load_detr_lineage_from_hf(
-        model_name,
-        DeformableDetrConfig,
-        "spotter_tpu.convert.deformable_detr_rules.deformable_detr_rules",
+        model_name, DeformableDetrConfig, deformable_detr_rules
     )
 
 
 def load_owlvit_from_hf(model_name: str) -> tuple[OwlViTConfig, dict]:
-    """Load + convert an OWL-ViT checkpoint; Orbax-cached per MODEL_NAME."""
+    """Load + convert an OWL-ViT / OWLv2 checkpoint; Orbax-cached per MODEL_NAME."""
     cached = _load_cache(_cache_path(model_name), OwlViTConfig)
     if cached is not None:
         logger.info("Loaded converted config+params for %s from cache", model_name)
@@ -191,14 +186,21 @@ def load_owlvit_from_hf(model_name: str) -> tuple[OwlViTConfig, dict]:
 
     import torch
     from transformers import AutoConfig
-    from transformers.models.owlvit.modeling_owlvit import OwlViTForObjectDetection
 
     from spotter_tpu.convert.owlvit_rules import owlvit_rules
     from spotter_tpu.convert.torch_to_jax import convert_state_dict
 
     cfg = OwlViTConfig.from_hf(AutoConfig.from_pretrained(model_name))
+    if cfg.objectness:
+        from transformers.models.owlv2.modeling_owlv2 import (
+            Owlv2ForObjectDetection as DetectionModel,
+        )
+    else:
+        from transformers.models.owlvit.modeling_owlvit import (
+            OwlViTForObjectDetection as DetectionModel,
+        )
     with torch.no_grad():
-        model = OwlViTForObjectDetection.from_pretrained(model_name).eval()
+        model = DetectionModel.from_pretrained(model_name).eval()
     # The rule table maps the detection path only (contrastive-only weights —
     # visual_projection, logit_scale — are deliberately unmapped); strict still
     # requires every mapped torch key to exist in the checkpoint.

@@ -460,11 +460,17 @@ class OwlViTVisionConfig:
 
 @dataclass(frozen=True)
 class OwlViTConfig:
-    """OWL-ViT open-vocabulary detector (google/owlvit-*)."""
+    """OWL-ViT / OWLv2 open-vocabulary detector (google/owlvit-*, google/owlv2-*).
+
+    OWLv2 is architecturally OWL-ViT plus an objectness head (and a
+    pad-to-square preprocess handled by the serving spec); `objectness` is
+    therefore the one config switch between the two families.
+    """
 
     text: OwlViTTextConfig = field(default_factory=OwlViTTextConfig)
     vision: OwlViTVisionConfig = field(default_factory=OwlViTVisionConfig)
     projection_dim: int = 512
+    objectness: bool = False  # True = OWLv2
 
     @classmethod
     def from_hf(cls, hf) -> "OwlViTConfig":
@@ -472,6 +478,7 @@ class OwlViTConfig:
             text=OwlViTTextConfig.from_hf(hf.text_config),
             vision=OwlViTVisionConfig.from_hf(hf.vision_config),
             projection_dim=hf.projection_dim,
+            objectness=hf.model_type == "owlv2",
         )
 
 

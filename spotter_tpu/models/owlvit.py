@@ -1,9 +1,13 @@
-"""Flax OWL-ViT (google/owlvit-*): open-vocabulary detection, text-conditioned.
+"""Flax OWL-ViT / OWLv2 (google/owlvit-*, google/owlv2-*): open-vocabulary
+detection, text-conditioned.
 
 Semantics match HF's OwlViTForObjectDetection (modeling_owlvit.py): CLIP-style
 vision and text towers, class-token merge over patch features, a text-query
 class head (normalized dot product with learned per-patch logit shift/scale)
-and a box MLP head biased toward each patch's grid position.
+and a box MLP head biased toward each patch's grid position. OWLv2
+(modeling_owlv2.py) shares the architecture and adds an objectness head over
+detached patch features (config.objectness); its pad-to-square preprocess
+lives in the serving spec (ops/preprocess.py "pad_square").
 
 TPU-first split (SURVEY.md §7): the queries a deployment serves are static
 (the amenity taxonomy, or an operator-supplied list), so `encode_text` runs
@@ -254,14 +258,34 @@ class OwlViTBoxHead(nn.Module):
         return nn.sigmoid(x.astype(jnp.float32) + jnp.asarray(bias, jnp.float32))
 
 
+class ObjectnessHead(nn.Module):
+    """OWLv2 objectness predictor: box-head-shaped MLP -> (B, P) logits.
+
+    HF Owlv2ForObjectDetection.objectness_predictor: a BoxPredictionHead with
+    out_dim=1 applied to DETACHED image features (the head trains without
+    shaping the backbone)."""
+
+    hidden_size: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, image_feats: jnp.ndarray) -> jnp.ndarray:
+        x = jax.lax.stop_gradient(image_feats)
+        x = nn.Dense(self.hidden_size, dtype=self.dtype, name="dense0")(x)
+        x = nn.gelu(x, approximate=False)
+        x = nn.Dense(self.hidden_size, dtype=self.dtype, name="dense1")(x)
+        x = nn.gelu(x, approximate=False)
+        return nn.Dense(1, dtype=self.dtype, name="dense2")(x)[..., 0]
+
+
 class OwlViTDetector(nn.Module):
-    """OWL-ViT detector.
+    """OWL-ViT / OWLv2 detector.
 
     `__call__(pixels, query_embeds)` is the serving forward:
-    {"logits": (B, P, Q), "pred_boxes": (B, P, 4) normalized cxcywh}.
-    `encode_text(input_ids, attention_mask)` -> normalized (Q, proj) query
-    embeddings, run once at build time. `detect_with_text` chains both (used
-    for init and parity testing).
+    {"logits": (B, P, Q), "pred_boxes": (B, P, 4) normalized cxcywh, plus
+    "objectness" (B, P) for OWLv2}. `encode_text(input_ids, attention_mask)`
+    -> normalized (Q, proj) query embeddings, run once at build time.
+    `detect_with_text` chains both (used for init and parity testing).
     """
 
     config: OwlViTConfig
@@ -280,6 +304,8 @@ class OwlViTDetector(nn.Module):
         )
         self.class_head = OwlViTClassHead(cfg, dtype=self.dtype)
         self.box_head = OwlViTBoxHead(cfg.vision, dtype=self.dtype)
+        if cfg.objectness:
+            self.objectness_head = ObjectnessHead(cfg.vision.hidden_size, dtype=self.dtype)
 
     def encode_text(
         self, input_ids: jnp.ndarray, attention_mask: Optional[jnp.ndarray] = None
@@ -301,7 +327,10 @@ class OwlViTDetector(nn.Module):
         gh = pixel_values.shape[1] // self.config.vision.patch_size
         gw = pixel_values.shape[2] // self.config.vision.patch_size
         boxes = self.box_head(image_feats, (gh, gw))
-        return {"logits": logits.astype(jnp.float32), "pred_boxes": boxes}
+        out = {"logits": logits.astype(jnp.float32), "pred_boxes": boxes}
+        if self.config.objectness:
+            out["objectness"] = self.objectness_head(image_feats).astype(jnp.float32)
+        return out
 
     def detect_with_text(
         self,

@@ -46,6 +46,7 @@ from spotter_tpu.ops.preprocess import (
     DETR_SPEC,
     IMAGENET_MEAN,
     IMAGENET_STD,
+    OWLV2_SPEC,
     OWLVIT_SPEC,
     RTDETR_SPEC,
     PreprocessSpec,
@@ -265,7 +266,7 @@ def _build_owlvit(model_name: str) -> BuiltDetector:
 
         cfg, params = load_owlvit_from_hf(model_name)
         module = OwlViTDetector(cfg, dtype=compute_dtype())
-        spec = OWLVIT_SPEC
+        spec = OWLV2_SPEC if cfg.objectness else OWLVIT_SPEC
         ids, mask = owlvit_tokenize(model_name, prompts, cfg.text.max_position_embeddings)
     # TPU-first split: the text tower runs ONCE here; the serving hot path is
     # vision-only with the (Q, proj) query matrix riding as a jit constant.
@@ -409,7 +410,13 @@ register(
 register(
     ModelFamily(name="rtdetr", matches=("rtdetr", "rt_detr", "rt-detr"), build=_build_rtdetr)
 )
-register(ModelFamily(name="owlvit", matches=("owlvit", "owl-vit", "owl_vit"), build=_build_owlvit))
+register(
+    ModelFamily(
+        name="owlvit",  # OWL-ViT and OWLv2 (same architecture + objectness head)
+        matches=("owlvit", "owl-vit", "owl_vit", "owlv2", "owl-v2", "owl_v2"),
+        build=_build_owlvit,
+    )
+)
 register(ModelFamily(name="yolos", matches=("yolos",), build=_build_yolos))
 register(
     # plain DETR (+ Table-Transformer, a pre-norm DETR with identical keys);

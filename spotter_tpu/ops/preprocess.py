@@ -29,7 +29,11 @@ class PreprocessSpec:
     mode "fixed": warp-resize to `size` (h, w) — RT-DETR (640, 640), OWL-ViT
     (768, 768). mode "shortest_edge": aspect-preserving resize so the short side
     is size[0] without the long side exceeding size[1], then zero-pad to the
-    (size[1], size[1])-bounded bucket — DETR/YOLOS (800, 1333).
+    (size[1], size[1])-bounded bucket — DETR/YOLOS (800, 1333). mode
+    "pad_square": pad bottom/right to a square with mid-gray (0.5
+    pre-normalization), then warp to `size` — OWLv2 (960, 960); the reported
+    target size is (max(h,w), max(h,w)), matching HF Owlv2ImageProcessor's
+    box rescale (its `_scale_boxes` uses the padded-square side for both axes).
     """
 
     mode: str = "fixed"
@@ -42,7 +46,7 @@ class PreprocessSpec:
     @property
     def input_hw(self) -> tuple[int, int]:
         """The static (h, w) every preprocessed array has."""
-        if self.mode == "fixed":
+        if self.mode in ("fixed", "pad_square"):
             return self.size
         assert self.pad_to is not None
         return self.pad_to
@@ -57,6 +61,9 @@ DETR_SPEC = PreprocessSpec(
     pad_to=(1333, 1333),
 )
 OWLVIT_SPEC = PreprocessSpec(mode="fixed", size=(768, 768), mean=CLIP_MEAN, std=CLIP_STD)
+OWLV2_SPEC = PreprocessSpec(
+    mode="pad_square", size=(960, 960), mean=CLIP_MEAN, std=CLIP_STD
+)
 
 
 def shortest_edge_size(hw: tuple[int, int], short: int, longest: int) -> tuple[int, int]:
@@ -92,6 +99,22 @@ def preprocess_image(
         resized = image.resize((tw, th), resample=Image.BILINEAR)
         arr = rescale_normalize(np.asarray(resized, dtype=np.float32))
         mask = np.ones((th, tw), dtype=np.float32)
+    elif spec.mode == "pad_square":
+        # OWLv2: pad bottom/right to square with 0.5 gray, warp to `size`.
+        # Equivalent content-first form: resize the image to its share of the
+        # target square, composite onto a gray canvas. Boxes come back in
+        # padded-square coordinates, hence the (max, max) reported size.
+        th, tw = spec.size
+        h, w = orig_hw
+        side = max(h, w)
+        rh = max(1, round(h / side * th))
+        rw = max(1, round(w / side * tw))
+        resized = image.resize((rw, rh), resample=Image.BILINEAR)
+        canvas = np.full((th, tw, 3), 0.5 / spec.rescale_factor, dtype=np.float32)
+        canvas[:rh, :rw] = np.asarray(resized, dtype=np.float32)
+        arr = rescale_normalize(canvas)
+        mask = np.ones((th, tw), dtype=np.float32)
+        orig_hw = (side, side)
     elif spec.mode == "shortest_edge":
         rh, rw = shortest_edge_size(orig_hw, spec.size[0], spec.size[1])
         resized = image.resize((rw, rh), resample=Image.BILINEAR)

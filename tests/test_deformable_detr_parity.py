@@ -117,3 +117,31 @@ def test_deformable_detr_parity_masked():
 
 def test_deformable_detr_parity_single_scale():
     _run_parity(_tiny_hf_config(num_feature_levels=1), with_mask=False)
+
+
+def test_timm_backbone_mapping():
+    """Published SenseTime/deformable-detr* checkpoints ship
+    use_timm_backbone=true with backbone='resnet50'; the from_hf mapping and
+    the 'timm' rule table must cover that path. (timm itself is absent here,
+    so the torch side can't be instantiated — the config mapping and the rule
+    table's key layout are pinned instead, mirroring
+    test_table_transformer_parity.py::test_timm_resnet18_backbone_mapping.)"""
+    hf = HFDeformableDetrConfig(num_labels=3)
+    assert hf.use_timm_backbone and hf.backbone == "resnet50"
+    cfg = DeformableDetrConfig.from_hf(hf)
+    assert cfg.backbone.style == "v1" and cfg.backbone.layer_type == "bottleneck"
+    assert cfg.backbone.out_indices == (2, 3, 4)  # strides 8/16/32
+
+    torch_keys = {k for _, k, _ in deformable_detr_rules(cfg, "timm").rules}
+    prefix = "model.backbone.conv_encoder.model."
+    assert f"{prefix}conv1.weight" in torch_keys  # timm stem naming
+    assert f"{prefix}layer4.2.conv3.weight" in torch_keys  # bottleneck depth 3
+    assert f"{prefix}layer1.0.downsample.0.weight" in torch_keys
+    # non-backbone half identical across namings
+    hf_keys = {k for _, k, _ in deformable_detr_rules(cfg, "hf").rules}
+    assert {k for k in torch_keys if not k.startswith(prefix)} == {
+        k for k in hf_keys if not k.startswith(prefix)
+    }
+
+    single = HFDeformableDetrConfig(num_labels=3, num_feature_levels=1)
+    assert DeformableDetrConfig.from_hf(single).backbone.out_indices == (4,)

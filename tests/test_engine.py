@@ -124,3 +124,11 @@ def test_conditional_detr_registry_routing():
     built = build_detector("microsoft/conditional-detr-resnet-50")
     assert built.postprocess == "sigmoid_topk"
     assert type(built.module).__name__ == "ConditionalDetrDetector"
+
+
+def test_owlv2_registry_routing(monkeypatch):
+    """owlv2 names resolve to the owlvit family (shared architecture)."""
+    monkeypatch.setenv("SPOTTER_TPU_TEXT_QUERIES", "tv")
+    built = build_detector("google/owlv2-base-patch16-ensemble")
+    assert built.postprocess == "sigmoid_max"
+    assert type(built.module).__name__ == "OwlViTDetector"

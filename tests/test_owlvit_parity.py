@@ -119,3 +119,74 @@ def test_owlvit_cached_query_path_matches(tiny_pair):
     np.testing.assert_allclose(
         np.asarray(split["pred_boxes"]), np.asarray(fused["pred_boxes"]), atol=1e-5
     )
+
+
+def test_owlv2_detection_parity():
+    """OWLv2 = OWL-ViT + objectness head, owlv2.* checkpoint prefix."""
+    from transformers import Owlv2Config as HFOwlv2Config
+    from transformers.models.owlv2.modeling_owlv2 import Owlv2ForObjectDetection
+
+    hf_cfg = HFOwlv2Config(
+        text_config=dict(
+            vocab_size=99, hidden_size=16, intermediate_size=24,
+            num_hidden_layers=2, num_attention_heads=2, max_position_embeddings=8,
+        ),
+        vision_config=dict(
+            hidden_size=20, intermediate_size=28, num_hidden_layers=2,
+            num_attention_heads=2, image_size=32, patch_size=8,
+        ),
+        projection_dim=16,
+    )
+    torch.manual_seed(0)
+    model = Owlv2ForObjectDetection(hf_cfg).eval()
+    cfg = OwlViTConfig.from_hf(hf_cfg)
+    assert cfg.objectness
+    params = convert_state_dict(model.state_dict(), owlvit_rules(cfg), strict=True)
+
+    rng = np.random.default_rng(2)
+    pixels = rng.uniform(-1, 1, size=(2, 3, 32, 32)).astype(np.float32)
+    attn = (QUERY_IDS != 0).astype(np.int64)
+
+    with torch.no_grad():
+        tout = model(
+            input_ids=torch.from_numpy(np.tile(QUERY_IDS, (2, 1))),
+            pixel_values=torch.from_numpy(pixels),
+            attention_mask=torch.from_numpy(np.tile(attn, (2, 1))),
+        )
+
+    jout = OwlViTDetector(cfg).apply(
+        {"params": params},
+        np.transpose(pixels, (0, 2, 3, 1)),
+        QUERY_IDS.astype(np.int32),
+        attn.astype(np.int32),
+        method=OwlViTDetector.detect_with_text,
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(jout["pred_boxes"]), tout.pred_boxes.numpy(), atol=2e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(jout["logits"]), tout.logits.numpy(), atol=5e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(jout["objectness"]),
+        tout.objectness_logits.numpy(),
+        atol=5e-4,
+        rtol=1e-3,
+    )
+
+
+def test_owlv2_pad_square_preprocess():
+    """pad_square reports the padded-square side as target size (HF box scaling)."""
+    from PIL import Image
+
+    from spotter_tpu.ops.preprocess import OWLV2_SPEC, preprocess_image
+
+    img = Image.fromarray(
+        np.random.default_rng(0).uniform(0, 255, (30, 60, 3)).astype("uint8")
+    )
+    arr, mask, hw = preprocess_image(img, OWLV2_SPEC)
+    assert arr.shape == (960, 960, 3) and hw == (60, 60)
+    # bottom half (beyond the content's 30/60 share of the square) is gray 0.5
+    gray = (0.5 - np.asarray(OWLV2_SPEC.mean)) / np.asarray(OWLV2_SPEC.std)
+    np.testing.assert_allclose(arr[600:], np.broadcast_to(gray, (360, 960, 3)), atol=1e-5)
