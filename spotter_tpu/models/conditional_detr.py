@@ -140,6 +140,8 @@ class ConditionalDetrDetector(nn.Module):
 
     config: ConditionalDetrConfig
     dtype: jnp.dtype = jnp.float32
+    # "mixed" policy: bf16 backbone convs, compute dtype for the transformer
+    backbone_dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(
@@ -150,10 +152,10 @@ class ConditionalDetrDetector(nn.Module):
         if pixel_mask is None:
             pixel_mask = jnp.ones((b, h, w), dtype=jnp.float32)
 
-        features = ResNetBackbone(cfg.backbone, dtype=self.dtype, name="backbone")(
-            pixel_values
-        )
-        feat = features[-1]
+        features = ResNetBackbone(
+            cfg.backbone, dtype=self.backbone_dtype or self.dtype, name="backbone"
+        )(pixel_values)
+        feat = features[-1].astype(self.dtype)
         _, fh, fw, _ = feat.shape
         mask = nearest_downsample_mask(pixel_mask, (fh, fw))
 

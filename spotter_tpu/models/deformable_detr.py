@@ -253,6 +253,8 @@ class DeformableDetrDetector(nn.Module):
 
     config: DeformableDetrConfig
     dtype: jnp.dtype = jnp.float32
+    # "mixed" policy: bf16 backbone convs, compute dtype for the transformer
+    backbone_dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(
@@ -264,9 +266,10 @@ class DeformableDetrDetector(nn.Module):
         if full_mask:
             pixel_mask = jnp.ones((b, h, w), dtype=jnp.float32)
 
-        features = ResNetBackbone(cfg.backbone, dtype=self.dtype, name="backbone")(
-            pixel_values
-        )
+        features = ResNetBackbone(
+            cfg.backbone, dtype=self.backbone_dtype or self.dtype, name="backbone"
+        )(pixel_values)
+        features = [f.astype(self.dtype) for f in features]
 
         # --- input projection to d_model: 1x1 conv + GroupNorm(32) per level,
         # extra pyramid levels via 3x3 stride-2 convs on the LAST RAW backbone

@@ -165,6 +165,9 @@ class DetrDetector(nn.Module):
 
     config: DetrConfig
     dtype: jnp.dtype = jnp.float32
+    # "mixed" policy: bf16 for the HBM-bound backbone convs, compute dtype
+    # (fp32 by default) for the transformer — cast at the feature boundary
+    backbone_dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(
@@ -175,10 +178,10 @@ class DetrDetector(nn.Module):
         if pixel_mask is None:
             pixel_mask = jnp.ones((b, h, w), dtype=jnp.float32)
 
-        features = ResNetBackbone(cfg.backbone, dtype=self.dtype, name="backbone")(
-            pixel_values
-        )
-        feat = features[-1]
+        features = ResNetBackbone(
+            cfg.backbone, dtype=self.backbone_dtype or self.dtype, name="backbone"
+        )(pixel_values)
+        feat = features[-1].astype(self.dtype)
         _, fh, fw, _ = feat.shape
         mask = nearest_downsample_mask(pixel_mask, (fh, fw))
 

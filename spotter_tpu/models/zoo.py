@@ -39,7 +39,7 @@ from spotter_tpu.models.owlvit import OwlViTDetector
 from spotter_tpu.models.yolos import YolosDetector
 from spotter_tpu.models.registry import ModelFamily, register
 from spotter_tpu.models.rtdetr import RTDetrDetector
-from spotter_tpu.utils.precision import compute_dtype
+from spotter_tpu.utils.precision import backbone_dtype, compute_dtype
 from spotter_tpu.ops.preprocess import (
     CLIP_MEAN,
     CLIP_STD,
@@ -88,7 +88,9 @@ def _build_rtdetr(model_name: str) -> BuiltDetector:
     if os.environ.get(TINY_ENV):
         cfg = tiny_rtdetr_config()
         spec = PreprocessSpec(mode="fixed", size=(64, 64))
-        module = RTDetrDetector(cfg, dtype=compute_dtype())
+        module = RTDetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
         params = _init_random(module, spec.input_hw)
         logger.info("Built tiny random RT-DETR for %s (%s)", model_name, TINY_ENV)
     else:
@@ -96,7 +98,9 @@ def _build_rtdetr(model_name: str) -> BuiltDetector:
 
         cfg, params = load_rtdetr_from_hf(model_name)
         spec = RTDETR_SPEC
-        module = RTDetrDetector(cfg, dtype=compute_dtype())
+        module = RTDetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
     return BuiltDetector(
         model_name=model_name,
         module=module,
@@ -134,7 +138,9 @@ def _build_detr(model_name: str) -> BuiltDetector:
             mode="shortest_edge", size=(48, 64), mean=IMAGENET_MEAN, std=IMAGENET_STD,
             pad_to=(64, 64),
         )
-        module = DetrDetector(cfg, dtype=compute_dtype())
+        module = DetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
         params = _init_random(module, spec.input_hw)
         logger.info("Built tiny random DETR for %s (%s)", model_name, TINY_ENV)
     else:
@@ -142,7 +148,9 @@ def _build_detr(model_name: str) -> BuiltDetector:
 
         cfg, params = load_detr_from_hf(model_name)
         spec = DETR_SPEC
-        module = DetrDetector(cfg, dtype=compute_dtype())
+        module = DetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
     return BuiltDetector(
         model_name=model_name,
         module=module,
@@ -312,7 +320,9 @@ def _build_conditional_detr(model_name: str) -> BuiltDetector:
             mode="shortest_edge", size=(48, 64), mean=IMAGENET_MEAN, std=IMAGENET_STD,
             pad_to=(64, 64),
         )
-        module = ConditionalDetrDetector(cfg, dtype=compute_dtype())
+        module = ConditionalDetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
         params = _init_random(module, spec.input_hw)
         logger.info(
             "Built tiny random Conditional-DETR for %s (%s)", model_name, TINY_ENV
@@ -324,7 +334,9 @@ def _build_conditional_detr(model_name: str) -> BuiltDetector:
 
         cfg, params = load_conditional_detr_from_hf(model_name)
         spec = DETR_SPEC
-        module = ConditionalDetrDetector(cfg, dtype=compute_dtype())
+        module = ConditionalDetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
     return BuiltDetector(
         model_name=model_name,
         module=module,
@@ -366,7 +378,9 @@ def _build_deformable_detr(model_name: str) -> BuiltDetector:
             mode="shortest_edge", size=(48, 64), mean=IMAGENET_MEAN, std=IMAGENET_STD,
             pad_to=(64, 64),
         )
-        module = DeformableDetrDetector(cfg, dtype=compute_dtype())
+        module = DeformableDetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
         params = _init_random(module, spec.input_hw)
         logger.info(
             "Built tiny random Deformable-DETR for %s (%s)", model_name, TINY_ENV
@@ -378,7 +392,9 @@ def _build_deformable_detr(model_name: str) -> BuiltDetector:
 
         cfg, params = load_deformable_detr_from_hf(model_name)
         spec = DETR_SPEC
-        module = DeformableDetrDetector(cfg, dtype=compute_dtype())
+        module = DeformableDetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
     return BuiltDetector(
         model_name=model_name,
         module=module,

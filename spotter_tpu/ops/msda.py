@@ -284,7 +284,13 @@ pallas_deformable_sampling.defvjp(_msda_fwd, _msda_bwd)
 
 # --- gather-free one-hot MXU kernel (the production TPU backend) ---
 
-S_TILE = 384  # three 128-lane vregs per one-hot tile column block
+# Five 128-lane vregs per one-hot tile column block. Swept on v5e (R101
+# batch 8, mixed policy): S_TILE 256/384/512/640/768 -> 64.0/58.5/54.4/
+# 52.1/54.9 ms end-to-end. 640 wins on tile-count alignment: the stride-8
+# level's 80x80=6400 positions split into exactly 10 tiles (512 pads 12.5
+# ->13) while staying small enough that the hit table still prunes.
+# Q_TILE 128 and finer S tiles both lose (more revisits / more grid steps).
+S_TILE = 640
 
 
 def _onehot_ref_math(rows, idx, w):
@@ -308,7 +314,31 @@ def _onehot_ref_math(rows, idx, w):
 Q_TILE = 64
 
 
-def _onehot_sparse_kernel(mask_ref, idx_ref, w_ref, v_ref, out_ref, *, s_tile: int):
+def _mxu_precision() -> jax.lax.Precision:
+    """MXU pass count for the one-hot contraction (SPOTTER_TPU_MSDA_PRECISION).
+
+    "highest" (default): 6-pass fp32 — bit-faithful to the gather reference
+    (kernel parity tests pin this). "default": single bf16 pass — the one-hot
+    weights are bilinear coefficients in [0,1] and values are activations, so
+    bf16 rounding costs ~1e-3 relative on sampled values; opt in when that
+    drift is acceptable for the deployment.
+    """
+    name = os.environ.get("SPOTTER_TPU_MSDA_PRECISION", "highest").strip().lower()
+    table = {
+        "highest": jax.lax.Precision.HIGHEST,
+        "default": jax.lax.Precision.DEFAULT,
+    }
+    if name not in table:
+        raise ValueError(
+            f"Unsupported SPOTTER_TPU_MSDA_PRECISION={name!r}; "
+            f"expected one of {sorted(table)}"
+        )
+    return table[name]
+
+
+def _onehot_sparse_kernel(
+    mask_ref, idx_ref, w_ref, v_ref, out_ref, *, s_tile: int, precision
+):
     # mask_ref is the scalar-prefetch (SMEM) hit table, indexed by grid ids
     qt, jc = idx_ref.shape[1], idx_ref.shape[2]
     i, nq, ns = pl.program_id(0), pl.program_id(1), pl.program_id(2)
@@ -332,7 +362,7 @@ def _onehot_sparse_kernel(mask_ref, idx_ref, w_ref, v_ref, out_ref, *, s_tile: i
             oh,
             v_ref[0].astype(jnp.float32),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=precision,
         )
         out_ref[0] = out_ref[0] + acc.astype(out_ref.dtype)
 
@@ -351,7 +381,10 @@ def pallas_onehot_sampling_sparse(rows, idx, w, mask, interpret: bool = False):
     _, qp, jc = idx.shape
     n_s = s_pad // S_TILE
     n_qt = qp // Q_TILE
-    kernel = partial(_onehot_sparse_kernel, s_tile=S_TILE)
+    # env parsed here (dispatch), not in the kernel body: typos fail fast
+    # with a readable error instead of mid-trace, and the environment isn't
+    # re-read per kernel trace
+    kernel = partial(_onehot_sparse_kernel, s_tile=S_TILE, precision=_mxu_precision())
     # upper bound: the mask is runtime data, so masked-off tiles can't be
     # subtracted statically; the true cost is this times the hit fraction
     flops = 2 * bh * n_s * (qp * S_TILE * hd + jc * qp * S_TILE)

@@ -101,9 +101,12 @@ def preprocess_image(
         mask = np.ones((th, tw), dtype=np.float32)
     elif spec.mode == "pad_square":
         # OWLv2: pad bottom/right to square with 0.5 gray, warp to `size`.
-        # Equivalent content-first form: resize the image to its share of the
-        # target square, composite onto a gray canvas. Boxes come back in
-        # padded-square coordinates, hence the (max, max) reported size.
+        # Content-first approximation of HF's pad-then-resize: resize the
+        # image to its (rounded) share of the target square, composite onto a
+        # gray canvas. The torch processor instead resizes the padded square,
+        # which blends content into gray across the seam — features for patch
+        # rows straddling the content boundary differ slightly. Boxes come
+        # back in padded-square coordinates, hence the (max, max) size.
         th, tw = spec.size
         h, w = orig_hw
         side = max(h, w)

@@ -1,4 +1,4 @@
-"""End-to-end numerical parity: Flax RTDetrDetector vs HF torch RTDetrV2ForObjectDetection.
+"""End-to-end numerical parity: Flax RTDetrDetector vs HF torch RT-DETR (v1 + v2).
 
 Tiny random-init config (no network). This is the JAX-side guarantee behind the
 reference's golden-box integration test (test_serve.py:293-300): if logits and
@@ -10,7 +10,9 @@ import numpy as np
 import pytest
 
 torch = pytest.importorskip("torch")
+from transformers import RTDetrConfig as HFRTDetrConfig
 from transformers import RTDetrResNetConfig, RTDetrV2Config
+from transformers.models.rt_detr.modeling_rt_detr import RTDetrForObjectDetection
 from transformers.models.rt_detr_v2.modeling_rt_detr_v2 import RTDetrV2ForObjectDetection
 
 from spotter_tpu.convert.rtdetr_rules import rtdetr_rules
@@ -19,7 +21,7 @@ from spotter_tpu.models.configs import RTDetrConfig
 from spotter_tpu.models.rtdetr import RTDetrDetector
 
 
-def _tiny_configs(decoder_method="default"):
+def _tiny_configs(version=2, decoder_method="default"):
     backbone = RTDetrResNetConfig(
         embedding_size=16,
         hidden_sizes=[16, 24, 32, 48],
@@ -27,7 +29,9 @@ def _tiny_configs(decoder_method="default"):
         layer_type="basic",
         out_features=["stage2", "stage3", "stage4"],
     )
-    hf = RTDetrV2Config(
+    config_cls = RTDetrV2Config if version == 2 else HFRTDetrConfig
+    kwargs = {"decoder_method": decoder_method} if version == 2 else {}
+    return config_cls(
         backbone_config=backbone,
         d_model=32,
         encoder_hidden_dim=32,
@@ -44,19 +48,19 @@ def _tiny_configs(decoder_method="default"):
         num_denoising=0,
         decoder_n_points=2,
         hidden_expansion=1.0,
-        decoder_method=decoder_method,
         # default 0.01 init leaves many spatial positions with identical
         # encoder scores -> top-k tie order diverges between torch and jax;
         # larger init makes scores distinct so selection is deterministic
         initializer_range=0.2,
+        **kwargs,
     )
-    return hf
 
 
-def _parity(decoder_method):
-    hf_cfg = _tiny_configs(decoder_method)
+def _parity(version, decoder_method="default"):
+    hf_cfg = _tiny_configs(version, decoder_method)
+    model_cls = RTDetrV2ForObjectDetection if version == 2 else RTDetrForObjectDetection
     torch.manual_seed(0)
-    model = RTDetrV2ForObjectDetection(hf_cfg).eval()
+    model = model_cls(hf_cfg).eval()
     with torch.no_grad():
         for m in model.modules():
             if isinstance(m, torch.nn.BatchNorm2d):
@@ -64,7 +68,8 @@ def _parity(decoder_method):
                 m.running_var.uniform_(0.8, 1.2)
 
     cfg = RTDetrConfig.from_hf(hf_cfg)
-    assert cfg.decoder_method == decoder_method
+    assert cfg.version == version
+    assert cfg.decoder_method == decoder_method and cfg.decoder_offset_scale == 0.5
     params = convert_state_dict(model.state_dict(), rtdetr_rules(cfg), strict=False)
 
     rng = np.random.default_rng(1)
@@ -85,8 +90,14 @@ def _parity(decoder_method):
 
 
 def test_rtdetr_v2_parity_bilinear():
-    _parity("default")
+    _parity(2, "default")
 
 
 def test_rtdetr_v2_parity_discrete():
-    _parity("discrete")
+    _parity(2, "discrete")
+
+
+def test_rtdetr_v1_parity():
+    """RT-DETR v1 (PekingU/rtdetr_r*vd, model_type rt_detr): same key layout,
+    v1 deformable sampling == v2 'default' at offset_scale 0.5."""
+    _parity(1)
