@@ -17,6 +17,7 @@ import numpy as np
 
 from spotter_tpu.models.configs import (
     ConditionalDetrConfig,
+    DabDetrConfig,
     DeformableDetrConfig,
     DetrConfig,
     OwlViTConfig,
@@ -175,6 +176,12 @@ def load_deformable_detr_from_hf(
     return _load_detr_lineage_from_hf(
         model_name, DeformableDetrConfig, deformable_detr_rules
     )
+
+
+def load_dab_detr_from_hf(model_name: str) -> tuple[DabDetrConfig, dict]:
+    from spotter_tpu.convert.dab_detr_rules import dab_detr_rules
+
+    return _load_detr_lineage_from_hf(model_name, DabDetrConfig, dab_detr_rules)
 
 
 def load_owlvit_from_hf(model_name: str) -> tuple[OwlViTConfig, dict]:

@@ -276,6 +276,82 @@ def timm_resnet_backbone(name: str) -> ResNetConfig:
 
 
 @dataclass(frozen=True)
+class DabDetrConfig:
+    """DAB-DETR (IDEA-Research/dab-detr-resnet-*) — DETR with 4D dynamic
+    anchor-box queries: each query is a learned (x, y, w, h) anchor whose sine
+    embedding conditions both self- and cross-attention, refined per decoder
+    layer through a shared box head. Mirrors HF DabDetrConfig
+    (configuration_dab_detr.py).
+    """
+
+    backbone: "ResNetConfig" = field(
+        default_factory=lambda: ResNetConfig(style="v1", out_indices=(4,))
+    )
+    num_labels: int = 91
+    d_model: int = 256  # hf "hidden_size"
+    num_queries: int = 300
+    query_dim: int = 4
+    encoder_layers: int = 6
+    decoder_layers: int = 6
+    encoder_attention_heads: int = 8
+    decoder_attention_heads: int = 8
+    encoder_ffn_dim: int = 2048
+    decoder_ffn_dim: int = 2048
+    activation_function: str = "prelu"
+    temperature_height: float = 20.0
+    temperature_width: float = 20.0
+    keep_query_pos: bool = False
+    layer_norm_eps: float = 1e-5
+    id2label: tuple[tuple[int, str], ...] = ()
+
+    @property
+    def id2label_dict(self) -> dict[int, str]:
+        return dict(self.id2label)
+
+    @classmethod
+    def from_hf(cls, hf) -> "DabDetrConfig":
+        check_no_dilation(hf)
+        if hf.query_dim != 4:
+            raise ValueError(f"Only query_dim=4 is supported, got {hf.query_dim}")
+        if getattr(hf, "num_patterns", 0):
+            raise ValueError("num_patterns > 0 is not supported")
+        if getattr(hf, "normalize_before", False):
+            raise ValueError("normalize_before (pre-norm) DAB-DETR is not supported")
+        if hf.activation_function != "prelu":
+            # the Flax model hardcodes the learned-PReLU FFN of the published
+            # checkpoints; other activations carry no activation_fn.weight
+            raise ValueError(
+                f"Only activation_function='prelu' is supported, got "
+                f"{hf.activation_function!r}"
+            )
+        if hf.use_timm_backbone:
+            backbone = timm_resnet_backbone(hf.backbone)
+        else:
+            backbone = replace(
+                ResNetConfig.from_hf(hf.backbone_config),
+                out_indices=(len(hf.backbone_config.depths),),
+            )
+        return cls(
+            backbone=backbone,
+            num_labels=hf.num_labels,
+            d_model=hf.hidden_size,
+            num_queries=hf.num_queries,
+            query_dim=hf.query_dim,
+            encoder_layers=hf.encoder_layers,
+            decoder_layers=hf.decoder_layers,
+            encoder_attention_heads=hf.encoder_attention_heads,
+            decoder_attention_heads=hf.decoder_attention_heads,
+            encoder_ffn_dim=hf.encoder_ffn_dim,
+            decoder_ffn_dim=hf.decoder_ffn_dim,
+            activation_function=hf.activation_function,
+            temperature_height=float(hf.temperature_height),
+            temperature_width=float(hf.temperature_width),
+            keep_query_pos=hf.keep_query_pos,
+            id2label=tuple(sorted((int(k), v) for k, v in hf.id2label.items())),
+        )
+
+
+@dataclass(frozen=True)
 class DeformableDetrConfig:
     """Deformable DETR (SenseTime/deformable-detr*) — multiscale deformable
     attention in BOTH encoder and decoder, with the plain / with-box-refine /

@@ -1,0 +1,260 @@
+"""Flax DAB-DETR detector (IDEA-Research/dab-detr-resnet-*).
+
+Served through the reference's `MODEL_NAME` AutoModel boundary
+(serve.py:199-205) like the other families. Architecture follows HF
+modeling_dab_detr.py: each object query is a learned 4D anchor box
+(x, y, w, h); its sine embedding drives the decoder's query positions
+(`ref_point_head`), its conditional cross-attention spatial half is scaled by
+a content-dependent transform (`query_scale`) and modulated by predicted
+anchor aspect (`ref_anchor_head`), and a shared 3-layer box head iteratively
+refines the anchors layer by layer. The encoder is a DETR encoder whose sine
+position map (temperature 20) is rescaled per layer by its own MLP. FFNs use
+a learned PReLU. Classification is focal-style — postprocess is the same
+sigmoid top-k as Conditional-DETR/RT-DETR.
+
+TPU-first notes: static shapes throughout; the shared-vs-per-layer head
+tying and the first-layer-only `ca_qpos_proj` are static Python branches at
+trace time; anchor refinement runs fp32 (repo box-precision policy) while
+the heavy matmuls run the compute dtype.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from spotter_tpu.models.conditional_detr import _attend
+from spotter_tpu.models.configs import DabDetrConfig
+from spotter_tpu.models.detr import nearest_downsample_mask, sine_position_from_mask
+from spotter_tpu.models.layers import (
+    MLPHead,
+    MultiHeadAttention,
+    PReLU,
+    inverse_sigmoid,
+)
+from spotter_tpu.models.resnet import ResNetBackbone
+
+
+def anchor_sine_embedding(boxes: jnp.ndarray, d_model: int) -> jnp.ndarray:
+    """Sine embedding of normalized (x, y, w, h) anchors, (B, Q, 2*d_model).
+
+    Matches gen_sine_position_embeddings (modeling_dab_detr.py): scale 2*pi,
+    d_model/2 channels per coordinate, concatenated [y, x, w, h].
+    """
+    dim = d_model // 2
+    dim_t = 10000.0 ** (2 * (np.arange(dim, dtype=np.float32) // 2) / dim)
+
+    def interleave(p):
+        return jnp.stack([jnp.sin(p[..., 0::2]), jnp.cos(p[..., 1::2])], axis=-1).reshape(
+            *p.shape[:-1], -1
+        )
+
+    def emb(coord):
+        return interleave(coord[..., None] * (2 * math.pi) / dim_t)
+
+    return jnp.concatenate(
+        [emb(boxes[..., 1]), emb(boxes[..., 0]), emb(boxes[..., 2]), emb(boxes[..., 3])],
+        axis=-1,
+    )
+
+
+class DabEncoderLayer(nn.Module):
+    """DETR-style post-norm encoder layer with a learned PReLU FFN."""
+
+    config: DabDetrConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, hidden: jnp.ndarray, pos: jnp.ndarray, attn_mask: Optional[jnp.ndarray]
+    ) -> jnp.ndarray:
+        cfg = self.config
+        attn = MultiHeadAttention(
+            cfg.d_model, cfg.encoder_attention_heads, dtype=self.dtype, name="self_attn"
+        )(hidden, position_embeddings=pos, attention_mask=attn_mask)
+        hidden = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="self_attn_layer_norm"
+        )(hidden + attn)
+        y = nn.Dense(cfg.encoder_ffn_dim, dtype=self.dtype, name="fc1")(hidden)
+        y = PReLU(dtype=self.dtype, name="activation")(y)
+        y = nn.Dense(cfg.d_model, dtype=self.dtype, name="fc2")(y)
+        return nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm"
+        )(hidden + y)
+
+
+class DabDecoderLayer(nn.Module):
+    """Conditional-style decoder layer with DAB's sine-conditioned cross-attn."""
+
+    config: DabDetrConfig
+    is_first: bool
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden: jnp.ndarray,  # (B, Q, D)
+        query_pos: jnp.ndarray,  # (B, Q, D) from ref_point_head
+        query_sine: jnp.ndarray,  # (B, Q, D) scaled+modulated anchor sine
+        memory: jnp.ndarray,  # (B, S, D)
+        memory_pos: jnp.ndarray,  # (B, S, D)
+        memory_mask: Optional[jnp.ndarray],
+    ) -> jnp.ndarray:
+        cfg = self.config
+        d, heads = cfg.d_model, cfg.decoder_attention_heads
+        dense = lambda name: nn.Dense(d, dtype=self.dtype, name=name)
+
+        # self-attention: decoupled content/position projections
+        q = dense("sa_qcontent_proj")(hidden) + dense("sa_qpos_proj")(query_pos)
+        k = dense("sa_kcontent_proj")(hidden) + dense("sa_kpos_proj")(query_pos)
+        v = dense("sa_v_proj")(hidden)
+        attn = _attend(q, k, v, heads, None, self.dtype)
+        attn = dense("self_attn_out_proj")(attn)
+        hidden = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="self_attn_layer_norm"
+        )(hidden + attn)
+
+        # cross-attention: per-head concat of content and spatial halves
+        qc = dense("ca_qcontent_proj")(hidden)
+        kc = dense("ca_kcontent_proj")(memory)
+        v = dense("ca_v_proj")(memory)
+        kpos = dense("ca_kpos_proj")(memory_pos)
+        if self.is_first or cfg.keep_query_pos:
+            qc = qc + dense("ca_qpos_proj")(query_pos)
+            kc = kc + kpos
+        qsine = dense("ca_qpos_sine_proj")(query_sine)
+
+        b, nq, _ = qc.shape
+        s = kc.shape[1]
+        head = d // heads
+        q2 = jnp.concatenate(
+            [qc.reshape(b, nq, heads, head), qsine.reshape(b, nq, heads, head)], axis=-1
+        ).reshape(b, nq, 2 * d)
+        k2 = jnp.concatenate(
+            [kc.reshape(b, s, heads, head), kpos.reshape(b, s, heads, head)], axis=-1
+        ).reshape(b, s, 2 * d)
+        cross = _attend(q2, k2, v, heads, memory_mask, self.dtype)
+        cross = dense("encoder_attn_out_proj")(cross)
+        hidden = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="encoder_attn_layer_norm"
+        )(hidden + cross)
+
+        ffn = nn.Dense(cfg.decoder_ffn_dim, dtype=self.dtype, name="fc1")(hidden)
+        ffn = PReLU(dtype=self.dtype, name="activation")(ffn)
+        ffn = nn.Dense(d, dtype=self.dtype, name="fc2")(ffn)
+        return nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm"
+        )(hidden + ffn)
+
+
+class DabDetrDetector(nn.Module):
+    """DAB-DETR: pixels (+mask) -> {"logits" (B,Q,C), "pred_boxes" cxcywh}."""
+
+    config: DabDetrConfig
+    dtype: jnp.dtype = jnp.float32
+    # "mixed" policy: bf16 backbone convs, compute dtype for the transformer
+    backbone_dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(
+        self, pixel_values: jnp.ndarray, pixel_mask: Optional[jnp.ndarray] = None
+    ) -> dict:
+        cfg = self.config
+        b, h, w, _ = pixel_values.shape
+        if pixel_mask is None:
+            pixel_mask = jnp.ones((b, h, w), dtype=jnp.float32)
+
+        features = ResNetBackbone(
+            cfg.backbone, dtype=self.backbone_dtype or self.dtype, name="backbone"
+        )(pixel_values)
+        feat = features[-1].astype(self.dtype)
+        _, fh, fw, _ = feat.shape
+        mask = nearest_downsample_mask(pixel_mask, (fh, fw))
+
+        pos = sine_position_from_mask(
+            mask, cfg.d_model // 2, (cfg.temperature_height, cfg.temperature_width)
+        ).astype(self.dtype)
+        src = nn.Conv(
+            cfg.d_model, (1, 1), use_bias=True, dtype=self.dtype, name="input_projection"
+        )(feat)
+        src = src.reshape(b, fh * fw, cfg.d_model)
+        pos = pos.reshape(b, fh * fw, cfg.d_model)
+        mask_flat = mask.reshape(b, fh * fw)
+        attn_mask = jnp.where(
+            mask_flat[:, None, None, :] > 0, 0.0, jnp.finfo(jnp.float32).min
+        )
+
+        # encoder: the sine map is rescaled per layer by a content MLP
+        enc_query_scale = MLPHead(
+            cfg.d_model, cfg.d_model, 2, dtype=self.dtype, name="encoder_query_scale"
+        )
+        for i in range(cfg.encoder_layers):
+            src = DabEncoderLayer(cfg, dtype=self.dtype, name=f"encoder_layer{i}")(
+                src, pos * enc_query_scale(src), attn_mask
+            )
+
+        # learned 4D anchor queries
+        refpoints = self.param(
+            "query_refpoints",
+            nn.initializers.normal(1.0),
+            (cfg.num_queries, cfg.query_dim),
+            jnp.float32,
+        )
+        ref = jnp.broadcast_to(
+            nn.sigmoid(refpoints)[None], (b, cfg.num_queries, cfg.query_dim)
+        )
+
+        ref_point_head = MLPHead(
+            cfg.d_model, cfg.d_model, 2, dtype=self.dtype, name="ref_point_head"
+        )
+        query_scale = MLPHead(
+            cfg.d_model, cfg.d_model, 2, dtype=self.dtype, name="query_scale"
+        )
+        ref_anchor_head = MLPHead(cfg.d_model, 2, 2, dtype=self.dtype, name="ref_anchor_head")
+        bbox_head = MLPHead(cfg.d_model, 4, 3, dtype=self.dtype, name="bbox_predictor")
+        decoder_ln = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="decoder_layernorm"
+        )
+
+        half = cfg.d_model // 2
+        hidden = jnp.zeros((b, cfg.num_queries, cfg.d_model), self.dtype)
+        intermediate = []
+        ref_inputs = []  # refs entering each layer (box decode anchor)
+        for i in range(cfg.decoder_layers):
+            ref_inputs.append(ref)
+            sine_full = anchor_sine_embedding(ref, cfg.d_model).astype(self.dtype)
+            query_pos = ref_point_head(sine_full)
+            scale = 1.0 if i == 0 else query_scale(hidden)
+            query_sine = sine_full[..., : cfg.d_model] * scale
+            # modulated height/width attention: rescale the x/y sine halves
+            # by predicted anchor aspect over the current anchor size
+            ref_hw = nn.sigmoid(ref_anchor_head(hidden).astype(jnp.float32))  # (B,Q,2)
+            mod_y = (ref_hw[..., 1] / ref[..., 3])[..., None].astype(self.dtype)
+            mod_x = (ref_hw[..., 0] / ref[..., 2])[..., None].astype(self.dtype)
+            query_sine = jnp.concatenate(
+                [query_sine[..., :half] * mod_y, query_sine[..., half:] * mod_x], axis=-1
+            )
+            hidden = DabDecoderLayer(
+                cfg, is_first=(i == 0), dtype=self.dtype, name=f"decoder_layer{i}"
+            )(hidden, query_pos, query_sine, src, pos, attn_mask)
+            # iterative anchor refinement through the SHARED box head (raw
+            # hidden; the output boxes below use the layernormed hidden)
+            delta = bbox_head(hidden).astype(jnp.float32)
+            ref = jax.lax.stop_gradient(nn.sigmoid(delta + inverse_sigmoid(ref)))
+            intermediate.append(decoder_ln(hidden))
+
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, name="class_embed")(
+            intermediate[-1]
+        )
+        aux_boxes = []
+        for hid, r in zip(intermediate, ref_inputs):
+            d = bbox_head(hid).astype(jnp.float32)
+            aux_boxes.append(nn.sigmoid(d + inverse_sigmoid(r)))
+        return {
+            "logits": logits.astype(jnp.float32),
+            "pred_boxes": aux_boxes[-1],
+            "aux_boxes": jnp.stack(aux_boxes, axis=1),
+        }

@@ -40,22 +40,29 @@ def nearest_downsample_mask(mask: jnp.ndarray, out_hw: tuple[int, int]) -> jnp.n
 
 
 def sine_position_from_mask(
-    mask: jnp.ndarray, embed_dim: int, temperature: float = 10000.0
+    mask: jnp.ndarray,
+    embed_dim: int,
+    temperature: float | tuple[float, float] = 10000.0,
 ) -> jnp.ndarray:
     """DetrSinePositionEmbedding(normalize=True): (B, h, w) mask -> (B, h, w, 2*half).
 
     Cumulative (1-based) row/col coordinates over valid pixels, normalized to
     [0, 2*pi], interleaved sin/cos per coordinate; y-half then x-half.
+    `temperature` may be a (height, width) pair — DAB-DETR uses 20/20
+    (DabDetrSinePositionEmbedding); the DETR lineage uses a single 10000.
     """
     half = embed_dim
     scale = 2.0 * math.pi
+    temp_y, temp_x = (
+        temperature if isinstance(temperature, tuple) else (temperature, temperature)
+    )
     y = jnp.cumsum(mask, axis=1)
     x = jnp.cumsum(mask, axis=2)
     y = y / (y[:, -1:, :] + 1e-6) * scale
     x = x / (x[:, :, -1:] + 1e-6) * scale
-    dim_t = temperature ** (2.0 * (np.arange(half, dtype=np.float32) // 2) / half)
-    pos_x = x[..., None] / dim_t
-    pos_y = y[..., None] / dim_t
+    rng = 2.0 * (np.arange(half, dtype=np.float32) // 2) / half
+    pos_x = x[..., None] / (temp_x**rng)
+    pos_y = y[..., None] / (temp_y**rng)
 
     def interleave(p):
         return jnp.stack([jnp.sin(p[..., 0::2]), jnp.cos(p[..., 1::2])], axis=-1).reshape(

@@ -99,6 +99,20 @@ class ConvNorm(nn.Module):
         return get_activation(self.activation)(x)
 
 
+class PReLU(nn.Module):
+    """torch nn.PReLU with num_parameters=1: max(0,x) + a*min(0,x), learned a.
+
+    DAB-DETR's FFN activation (ACT2FN["prelu"]) — the one activation in the
+    zoo that carries a weight, so it can't go through get_activation."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        a = self.param("weight", nn.initializers.constant(0.25), (1,), jnp.float32)
+        return jnp.maximum(x, 0) + a.astype(x.dtype) * jnp.minimum(x, 0)
+
+
 class MLPHead(nn.Module):
     """DETR-style MLP prediction head: Linear stack with ReLU between layers."""
 

@@ -23,6 +23,7 @@ from spotter_tpu.models.coco import coco_id2label_80
 from spotter_tpu.models.configs import (
     ConditionalDetrConfig,
     RESNET_PRESETS,
+    DabDetrConfig,
     DeformableDetrConfig,
     DetrConfig,
     OwlViTConfig,
@@ -33,6 +34,7 @@ from spotter_tpu.models.configs import (
     YolosConfig,
 )
 from spotter_tpu.models.conditional_detr import ConditionalDetrDetector
+from spotter_tpu.models.dab_detr import DabDetrDetector
 from spotter_tpu.models.deformable_detr import DeformableDetrDetector
 from spotter_tpu.models.detr import DetrDetector
 from spotter_tpu.models.owlvit import OwlViTDetector
@@ -407,6 +409,57 @@ def _build_deformable_detr(model_name: str) -> BuiltDetector:
     )
 
 
+def tiny_dab_detr_config(num_labels: int = 80) -> DabDetrConfig:
+    return DabDetrConfig(
+        backbone=ResNetConfig(
+            embedding_size=8, hidden_sizes=(8, 12, 16, 24), depths=(1, 1, 1, 1),
+            layer_type="basic", style="v1", out_indices=(4,),
+        ),
+        num_labels=num_labels,
+        d_model=32,
+        num_queries=9,
+        encoder_layers=1,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=48,
+        decoder_ffn_dim=48,
+        id2label=tuple(coco_id2label_80().items()),
+    )
+
+
+def _build_dab_detr(model_name: str) -> BuiltDetector:
+    if os.environ.get(TINY_ENV):
+        cfg = tiny_dab_detr_config()
+        spec = PreprocessSpec(
+            mode="shortest_edge", size=(48, 64), mean=IMAGENET_MEAN, std=IMAGENET_STD,
+            pad_to=(64, 64),
+        )
+        module = DabDetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
+        params = _init_random(module, spec.input_hw)
+        logger.info("Built tiny random DAB-DETR for %s (%s)", model_name, TINY_ENV)
+    else:
+        from spotter_tpu.convert.loader import load_dab_detr_from_hf  # lazy: needs torch
+
+        cfg, params = load_dab_detr_from_hf(model_name)
+        spec = DETR_SPEC
+        module = DabDetrDetector(
+            cfg, dtype=compute_dtype(), backbone_dtype=backbone_dtype()
+        )
+    return BuiltDetector(
+        model_name=model_name,
+        module=module,
+        params=params,
+        preprocess_spec=spec,
+        postprocess="sigmoid_topk",  # focal head, NMS-free top-k
+        id2label=cfg.id2label_dict,
+        num_top_queries=min(300, cfg.num_queries),
+        needs_mask=True,
+    )
+
+
 register(
     # must precede the plain-detr family: "conditional-detr-resnet-50"
     # also contains the "detr-resnet" substring
@@ -414,6 +467,12 @@ register(
         name="conditional_detr",
         matches=("conditional-detr", "conditional_detr"),
         build=_build_conditional_detr,
+    )
+)
+register(
+    # must precede plain-detr: "dab-detr-resnet-50" contains "detr-resnet"
+    ModelFamily(
+        name="dab_detr", matches=("dab-detr", "dab_detr"), build=_build_dab_detr
     )
 )
 register(

@@ -1,0 +1,94 @@
+"""Numerical parity: Flax DabDetrDetector vs HF torch DabDetrForObjectDetection.
+
+Tiny random-init config, no network — covers the anchor-sine conditioning,
+per-layer anchor refinement, PReLU FFNs, encoder pos rescaling, and the
+padded-pixel-mask path."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import DabDetrConfig as HFDabDetrConfig
+from transformers import ResNetConfig as HFResNetConfig
+from transformers.models.dab_detr.modeling_dab_detr import DabDetrForObjectDetection
+
+from spotter_tpu.convert.dab_detr_rules import dab_detr_rules
+from spotter_tpu.convert.torch_to_jax import convert_state_dict
+from spotter_tpu.models.configs import DabDetrConfig
+from spotter_tpu.models.dab_detr import DabDetrDetector
+
+
+def _tiny_hf_config(**kw):
+    backbone = HFResNetConfig(
+        embedding_size=8,
+        hidden_sizes=[8, 12, 16, 24],
+        depths=[1, 1, 1, 1],
+        layer_type="basic",
+        out_features=["stage4"],
+    )
+    return HFDabDetrConfig(
+        use_timm_backbone=False,
+        use_pretrained_backbone=False,
+        backbone=None,
+        backbone_config=backbone,
+        hidden_size=32,
+        encoder_layers=2,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=48,
+        decoder_ffn_dim=48,
+        num_queries=9,
+        num_labels=7,
+        **kw,
+    )
+
+
+def _run_parity(hf_cfg, with_mask: bool):
+    torch.manual_seed(0)
+    model = DabDetrForObjectDetection(hf_cfg).eval()
+    with torch.no_grad():
+        for m in model.modules():
+            if hasattr(m, "running_mean"):
+                m.running_mean.uniform_(-0.2, 0.2)
+                m.running_var.uniform_(0.8, 1.2)
+
+    cfg = DabDetrConfig.from_hf(hf_cfg)
+    params = convert_state_dict(model.state_dict(), dab_detr_rules(cfg), strict=True)
+
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, size=(2, 3, 64, 96)).astype(np.float32)
+    if with_mask:
+        mask = np.zeros((2, 64, 96), dtype=np.int64)
+        mask[0, :64, :80] = 1
+        mask[1, :48, :96] = 1
+    else:
+        mask = np.ones((2, 64, 96), dtype=np.int64)
+
+    with torch.no_grad():
+        tout = model(torch.from_numpy(x), pixel_mask=torch.from_numpy(mask))
+
+    jout = DabDetrDetector(cfg).apply(
+        {"params": params},
+        np.transpose(x, (0, 2, 3, 1)),
+        mask.astype(np.float32) if with_mask else None,
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(jout["pred_boxes"]), tout.pred_boxes.numpy(), atol=5e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(jout["logits"]), tout.logits.numpy(), atol=1e-3, rtol=1e-3
+    )
+
+
+def test_dab_detr_parity():
+    _run_parity(_tiny_hf_config(), with_mask=False)
+
+
+def test_dab_detr_parity_masked():
+    _run_parity(_tiny_hf_config(), with_mask=True)
+
+
+def test_dab_detr_parity_keep_query_pos():
+    _run_parity(_tiny_hf_config(keep_query_pos=True), with_mask=False)

@@ -132,3 +132,21 @@ def test_owlv2_registry_routing(monkeypatch):
     built = build_detector("google/owlv2-base-patch16-ensemble")
     assert built.postprocess == "sigmoid_max"
     assert type(built.module).__name__ == "OwlViTDetector"
+
+
+def test_dab_detr_registry_routing():
+    """'dab-detr-resnet-50' contains 'detr-resnet'; must route to dab_detr."""
+    built = build_detector("IDEA-Research/dab-detr-resnet-50")
+    assert built.postprocess == "sigmoid_topk" and built.needs_mask
+    assert type(built.module).__name__ == "DabDetrDetector"
+
+
+def test_dab_detr_family_end_to_end():
+    """Tiny DAB-DETR through the full engine path (shortest-edge + mask +
+    sigmoid top-k)."""
+    built = build_detector("IDEA-Research/dab-detr-resnet-50")
+    eng = InferenceEngine(built, threshold=0.0, batch_buckets=(1, 2))
+    results = eng.detect(_imgs(3, hw=(40, 72)))
+    assert len(results) == 3
+    for dets in results:
+        assert all(set(d) == {"label", "score", "box"} for d in dets)
