@@ -1,11 +1,50 @@
 #include "handlers.h"
 
+#include <stdarg.h>
+#include <time.h>
+
+#include <cstdio>
 #include <fstream>
+#include <set>
 #include <sstream>
 
 namespace spotter {
 
 namespace {
+
+// Timestamped operational log, the log.Printf analog. The reference manager
+// logs every request and outcome (handlers.go:67, 121, 158, 195-200, 377);
+// stdout is the k8s-native sink (kubectl logs).
+void Logf(const char* fmt, ...) {
+  char ts[32];
+  time_t now = time(nullptr);
+  struct tm tm_buf;
+  localtime_r(&now, &tm_buf);
+  strftime(ts, sizeof(ts), "%Y/%m/%d %H:%M:%S", &tm_buf);
+  char msg[8192];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(msg, sizeof(msg), fmt, ap);
+  va_end(ap);
+  // single stdio call: handlers log from pool threads, and per-call locking
+  // is the only atomicity stdio gives (Go log.Printf writes one line too)
+  fprintf(stdout, "%s %s\n", ts, msg);
+  fflush(stdout);
+}
+
+// "x-request-id" -> "X-Request-Id" (Go textproto.CanonicalMIMEHeaderKey
+// analog): parsed header keys are lower-cased, responses should carry
+// canonical names.
+std::string CanonicalHeader(const std::string& key) {
+  std::string out = key;
+  bool upper = true;
+  for (auto& c : out) {
+    c = static_cast<char>(upper ? toupper(static_cast<unsigned char>(c))
+                                : tolower(static_cast<unsigned char>(c)));
+    upper = c == '-';
+  }
+  return out;
+}
 
 std::string ReadFile(const std::string& path, bool* ok) {
   std::ifstream f(path, std::ios::binary);
@@ -33,6 +72,29 @@ bool ValidName(const std::string& s) {
 }
 
 }  // namespace
+
+bool ParseTopology(const std::string& topology, int* total_chips) {
+  // "AxB" or "AxBxC" with positive integer dims; total = product
+  long total = 1;
+  size_t pos = 0;
+  int dims = 0;
+  while (pos <= topology.size()) {
+    size_t x = topology.find('x', pos);
+    std::string dim = topology.substr(
+        pos, x == std::string::npos ? std::string::npos : x - pos);
+    if (dim.empty() || dim.find_first_not_of("0123456789") != std::string::npos)
+      return false;
+    long v = strtol(dim.c_str(), nullptr, 10);
+    if (v <= 0 || v > 256) return false;
+    total *= v;
+    ++dims;
+    if (x == std::string::npos) break;
+    pos = x + 1;
+  }
+  if (dims < 1 || dims > 3 || total > 4096) return false;
+  *total_chips = static_cast<int>(total);
+  return true;
+}
 
 bool RenderTemplate(const std::string& tmpl,
                     const std::map<std::string, std::string>& params,
@@ -114,6 +176,41 @@ HttpResponse HandleDeploy(const ManagerOptions& opts, K8sClient* client,
       return TextResponse(400, "Invalid characters in parameter " + key + "\n");
   }
 
+  // Chip accounting derived from the slice topology, not hardcoded. v5e
+  // convention: topologies up to 8 chips are single-host; larger slices are
+  // 4-chips-per-host machines (ct5lp-hightpu-4t). One Serve replica per chip
+  // (each claims resources {TPU: 1}; Ray sets TPU_VISIBLE_CHIPS per actor
+  // the way it sets CUDA_VISIBLE_DEVICES), so a 4-chip pod runs 4 replicas
+  // instead of idling 3 chips.
+  int total_chips = 0;
+  if (!ParseTopology(params["Topology"], &total_chips))
+    return TextResponse(
+        400, "Invalid topology '" + params["Topology"] +
+                 "' (expected AxB or AxBxC positive integer dims)\n");
+  int num_workers = atoi(params["NumWorkers"].c_str());
+  if (num_workers <= 0 || num_workers > 256)
+    return TextResponse(400, "Invalid numworkers '" + params["NumWorkers"] +
+                                 "' (expected 1-256)\n");
+  int chips_per_host = total_chips <= 8 ? total_chips : 4;
+  if (total_chips % chips_per_host)
+    return TextResponse(
+        400, "Invalid topology '" + params["Topology"] + "': " +
+                 std::to_string(total_chips) + " chips is not schedulable as " +
+                 std::to_string(chips_per_host) + "-chip hosts\n");
+  int num_hosts = total_chips / chips_per_host;
+  params["ChipsPerHost"] = std::to_string(chips_per_host);
+  params["NumHosts"] = std::to_string(num_hosts);
+  params["NumReplicas"] = std::to_string(num_workers * total_chips);
+  // Elastic recovery bounds (reference rayservice-template.yaml:43-45
+  // autoscales 1→2): NumWorkers is the floor, 2x is the ceiling.
+  params["MaxWorkers"] = std::to_string(2 * num_workers);
+
+  Logf("Deploy request: image=%s model=%s accelerator=%s topology=%s "
+       "workers=%d chips/host=%d hosts/worker=%d serve_replicas=%s",
+       image.c_str(), params["ModelName"].c_str(),
+       params["Accelerator"].c_str(), params["Topology"].c_str(), num_workers,
+       chips_per_host, num_hosts, params["NumReplicas"].c_str());
+
   bool ok = false;
   std::string tmpl =
       ReadFile(opts.configs_dir + "/" + opts.template_file, &ok);
@@ -121,18 +218,30 @@ HttpResponse HandleDeploy(const ManagerOptions& opts, K8sClient* client,
     return TextResponse(500, "Error reading RayService template\n");
 
   std::string manifest, render_err;
-  if (!RenderTemplate(tmpl, params, &manifest, &render_err))
+  if (!RenderTemplate(tmpl, params, &manifest, &render_err)) {
+    Logf("Error rendering RayService template: %s", render_err.c_str());
     return TextResponse(500, "Error rendering RayService template: " +
                                  render_err + "\n");
+  }
+  // the reference logs the full generated manifest (handlers.go:121)
+  Logf("Generated RayService manifest:\n%s", manifest.c_str());
 
   ClientResult res =
       client->ApplyRayService(opts.ns, opts.service_name, manifest);
-  if (!res.ok)
+  if (!res.ok) {
+    Logf("Error applying RayService: %s", res.error.c_str());
     return TextResponse(500, "Error applying RayService: " + res.error + "\n");
-  if (res.status < 200 || res.status >= 300)
+  }
+  if (res.status < 200 || res.status >= 300) {
+    Logf("Error applying RayService: apiserver returned %d: %s", res.status,
+         res.body.c_str());
     return TextResponse(500, "Error applying RayService: apiserver returned " +
                                  std::to_string(res.status) + ": " + res.body +
                                  "\n");
+  }
+  // apply outcome incl. object identity (handlers.go:195-200 logs the UID)
+  Logf("Successfully applied RayService '%s/%s' (apiserver %d)",
+       opts.ns.c_str(), opts.service_name.c_str(), res.status);
   return TextResponse(
       200, "Successfully deployed RayService '" + opts.service_name +
                "' with image '" + image + "'\n");
@@ -144,16 +253,26 @@ HttpResponse HandleDelete(const ManagerOptions& opts, K8sClient* client,
     return TextResponse(405, "Method Not Allowed\n");
 
   ClientResult res = client->DeleteRayService(opts.ns, opts.service_name);
-  if (!res.ok)
+  if (!res.ok) {
+    Logf("Error deleting RayService: %s", res.error.c_str());
     return TextResponse(500, "Error deleting RayService: " + res.error + "\n");
-  if (res.status == 404)  // NotFound is success with a distinct message
-                          // (handlers.go:233-238)
+  }
+  if (res.status == 404) {  // NotFound is success with a distinct message
+                            // (handlers.go:233-238)
+    Logf("RayService '%s/%s' did not exist", opts.ns.c_str(),
+         opts.service_name.c_str());
     return TextResponse(200, "RayService '" + opts.service_name +
                                  "' did not exist\n");
-  if (res.status < 200 || res.status >= 300)
+  }
+  if (res.status < 200 || res.status >= 300) {
+    Logf("Error deleting RayService: apiserver returned %d: %s", res.status,
+         res.body.c_str());
     return TextResponse(500, "Error deleting RayService: apiserver returned " +
                                  std::to_string(res.status) + ": " + res.body +
                                  "\n");
+  }
+  Logf("Successfully deleted RayService '%s/%s'", opts.ns.c_str(),
+       opts.service_name.c_str());
   return TextResponse(
       200, "Successfully deleted RayService '" + opts.service_name + "'\n");
 }
@@ -163,24 +282,47 @@ HttpResponse HandleDetectProxy(const ManagerOptions& opts,
   if (req.method != "POST")
     return TextResponse(405, "Method Not Allowed\n");
 
+  // Clone ALL request headers into the proxied request (the reference does
+  // `proxyReq.Header = r.Header.Clone()`, handlers.go:320-339) so auth /
+  // tracing headers survive. Hop-by-hop and framing headers are the
+  // transport's job: HttpDo writes its own Host and Content-Length, and the
+  // connection-level fields must not be forwarded (RFC 9110 §7.6.1).
+  // "expect" included: forwarding 100-continue would make the backend emit
+  // an interim response the blocking client does not negotiate.
+  static const std::set<std::string> kSkipRequest{
+      "host",       "content-length", "connection", "transfer-encoding",
+      "keep-alive", "upgrade",        "te",         "trailer",
+      "proxy-connection", "expect"};
   std::map<std::string, std::string> headers;
-  auto ct = req.headers.find("content-type");
-  headers["Content-Type"] =
-      ct == req.headers.end() ? "application/json" : ct->second;
+  for (const auto& [k, v] : req.headers) {
+    if (!kSkipRequest.count(k)) headers[CanonicalHeader(k)] = v;
+  }
+  if (!headers.count("Content-Type")) headers["Content-Type"] = "application/json";
 
   ClientResult res =
       HttpDo("POST", opts.backend_url, headers, req.body, opts.proxy_timeout_s);
-  if (!res.ok)  // 502 + message prefix matching the reference
-                // (handlers.go:341-354)
+  if (!res.ok) {  // 502 + message prefix matching the reference
+                  // (handlers.go:341-354)
+    Logf("Error forwarding request to target %s: %s", opts.backend_url.c_str(),
+         res.error.c_str());
     return TextResponse(502,
                         "Failed to reach backend service: " + res.error + "\n");
+  }
 
+  // Copy ALL backend response headers + status back (handlers.go:357-365);
+  // the server rewrites framing (Content-Length/Connection) itself.
+  static const std::set<std::string> kSkipResponse{
+      "content-length", "transfer-encoding", "connection", "keep-alive"};
   HttpResponse out;
   out.status = res.status;
-  auto rct = res.headers.find("content-type");
-  out.headers["Content-Type"] =
-      rct == res.headers.end() ? "application/json" : rct->second;
+  for (const auto& [k, v] : res.headers) {
+    if (!kSkipResponse.count(k)) out.headers[CanonicalHeader(k)] = v;
+  }
+  if (!out.headers.count("Content-Type"))
+    out.headers["Content-Type"] = "application/json";
   out.body = res.body;
+  Logf("Successfully proxied detection request to %s (backend %d, %zu bytes)",
+       opts.backend_url.c_str(), res.status, res.body.size());
   return out;
 }
 

@@ -23,6 +23,12 @@ bool RenderTemplate(const std::string& tmpl,
                     const std::map<std::string, std::string>& params,
                     std::string* out, std::string* error);
 
+// "AxB" / "AxBxC" slice topology -> total chip count; false on malformed
+// input. Drives the derived template params (ChipsPerHost, NumHosts,
+// NumReplicas) so chip accounting follows the requested topology instead of
+// being hardcoded.
+bool ParseTopology(const std::string& topology, int* total_chips);
+
 struct ManagerOptions {
   std::string web_dir = "web";          // index.html location
   std::string configs_dir = "configs";  // rayservice template location

@@ -324,6 +324,10 @@ bool ParseResponse(const std::string& raw, ClientResult* out) {
   if (line.rfind("HTTP/", 0) != 0) return false;
   size_t sp = line.find(' ');
   out->status = atoi(line.c_str() + sp + 1);
+  // 1xx responses (e.g. "100 Continue") are interim: the real response
+  // follows in the same stream (RFC 9110 §15.2)
+  if (out->status >= 100 && out->status < 200)
+    return ParseResponse(raw.substr(header_end + 4), out);
   while (std::getline(hs, line)) {
     if (!line.empty() && line.back() == '\r') line.pop_back();
     size_t colon = line.find(':');

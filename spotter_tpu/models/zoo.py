@@ -346,7 +346,9 @@ def _build_conditional_detr(model_name: str) -> BuiltDetector:
         preprocess_spec=spec,
         postprocess="sigmoid_topk",  # focal head, NMS-free top-k like RT-DETR
         id2label=cfg.id2label_dict,
-        num_top_queries=min(300, cfg.num_queries),
+        # ConditionalDetrImageProcessor.post_process_object_detection defaults
+        # to top_k=100; matching it keeps the serve contract identical
+        num_top_queries=min(100, cfg.num_queries),
         needs_mask=True,
     )
 
@@ -455,7 +457,11 @@ def _build_dab_detr(model_name: str) -> BuiltDetector:
         preprocess_spec=spec,
         postprocess="sigmoid_topk",  # focal head, NMS-free top-k
         id2label=cfg.id2label_dict,
-        num_top_queries=min(300, cfg.num_queries),
+        # HF DAB-DETR has no processor of its own; its checkpoints pair with
+        # ConditionalDetrImageProcessor, whose post_process_object_detection
+        # defaults to top_k=100 — detections ranked 101+ would never be
+        # returned by the reference serve path
+        num_top_queries=min(100, cfg.num_queries),
         needs_mask=True,
     )
 

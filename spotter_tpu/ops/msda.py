@@ -322,6 +322,10 @@ def _mxu_precision() -> jax.lax.Precision:
     weights are bilinear coefficients in [0,1] and values are activations, so
     bf16 rounding costs ~1e-3 relative on sampled values; opt in when that
     drift is acceptable for the deployment.
+
+    Read ONCE at import (module constant below) like the other env knobs:
+    the value is baked into jit-compiled programs and is not part of any jit
+    cache key, so changing the env after first trace could never take effect.
     """
     name = os.environ.get("SPOTTER_TPU_MSDA_PRECISION", "highest").strip().lower()
     table = {
@@ -334,6 +338,10 @@ def _mxu_precision() -> jax.lax.Precision:
             f"expected one of {sorted(table)}"
         )
     return table[name]
+
+
+# process-start-only knob (see _mxu_precision docstring)
+MSDA_MXU_PRECISION = _mxu_precision()
 
 
 def _onehot_sparse_kernel(
@@ -384,7 +392,7 @@ def pallas_onehot_sampling_sparse(rows, idx, w, mask, interpret: bool = False):
     # env parsed here (dispatch), not in the kernel body: typos fail fast
     # with a readable error instead of mid-trace, and the environment isn't
     # re-read per kernel trace
-    kernel = partial(_onehot_sparse_kernel, s_tile=S_TILE, precision=_mxu_precision())
+    kernel = partial(_onehot_sparse_kernel, s_tile=S_TILE, precision=MSDA_MXU_PRECISION)
     # upper bound: the mask is runtime data, so masked-off tiles can't be
     # subtracted statically; the true cost is this times the hit fraction
     flops = 2 * bh * n_s * (qp * S_TILE * hd + jc * qp * S_TILE)

@@ -100,22 +100,34 @@ def preprocess_image(
         arr = rescale_normalize(np.asarray(resized, dtype=np.float32))
         mask = np.ones((th, tw), dtype=np.float32)
     elif spec.mode == "pad_square":
-        # OWLv2: pad bottom/right to square with 0.5 gray, warp to `size`.
-        # Content-first approximation of HF's pad-then-resize: resize the
-        # image to its (rounded) share of the target square, composite onto a
-        # gray canvas. The torch processor instead resizes the padded square,
-        # which blends content into gray across the seam — features for patch
-        # rows straddling the content boundary differ slightly. Boxes come
+        # OWLv2: rescale to [0,1], pad bottom/right to square with 0.5 gray,
+        # resize the PADDED square to `size`, then normalize — the exact HF
+        # Owlv2ImageProcessor order (pad → skimage-style warp), so patch
+        # features across the content/gray seam match the torch pipeline
+        # pixel-for-pixel (tests/test_preprocess.py pins this). Boxes come
         # back in padded-square coordinates, hence the (max, max) size.
+        import scipy.ndimage as ndi  # the HF processor itself requires scipy
+
         th, tw = spec.size
         h, w = orig_hw
         side = max(h, w)
-        rh = max(1, round(h / side * th))
-        rw = max(1, round(w / side * tw))
-        resized = image.resize((rw, rh), resample=Image.BILINEAR)
-        canvas = np.full((th, tw, 3), 0.5 / spec.rescale_factor, dtype=np.float32)
-        canvas[:rh, :rw] = np.asarray(resized, dtype=np.float32)
-        arr = rescale_normalize(canvas)
+        padded = np.full((side, side, 3), 0.5, dtype=np.float32)
+        padded[:h, :w] = np.asarray(image, dtype=np.float32) * spec.rescale_factor
+        # skimage.transform.resize semantics (anti_aliasing=True, order=1,
+        # mode="mirror", grid_mode zoom), as vendored by the HF processor
+        factors = np.divide(padded.shape, (th, tw, 3))
+        sigma = np.maximum(0.0, (factors - 1.0) / 2.0)
+        filtered = (
+            ndi.gaussian_filter(padded, sigma, mode="mirror") if sigma.any() else padded
+        )
+        out = ndi.zoom(
+            filtered, 1.0 / factors, order=1, mode="mirror", grid_mode=True
+        )
+        arr = np.clip(out, padded.min(), padded.max()).astype(np.float32)
+        if spec.mean is not None and spec.std is not None:
+            arr = (arr - np.asarray(spec.mean, dtype=np.float32)) / np.asarray(
+                spec.std, dtype=np.float32
+            )
         mask = np.ones((th, tw), dtype=np.float32)
         orig_hw = (side, side)
     elif spec.mode == "shortest_edge":

@@ -40,6 +40,12 @@ from spotter_tpu.schemas import (
 )
 from spotter_tpu.taxonomy import AMENITIES_MAPPING
 
+# Fetch retry policy (serve.py:84-88). Module-level so tests can zero the
+# backoff instead of sleeping through it.
+FETCH_RETRY_ATTEMPTS = 3
+FETCH_RETRY_WAIT_MIN_S = 4.0
+FETCH_RETRY_WAIT_MAX_S = 10.0
+
 
 class AmenitiesDetector:
     """Framework-agnostic core; Ray Serve / aiohttp adapters wrap this."""
@@ -63,8 +69,10 @@ class AmenitiesDetector:
         try:
             image_bytes = None
             retries = AsyncRetrying(
-                stop=stop_after_attempt(3),
-                wait=wait_exponential(multiplier=1, min=4, max=10),
+                stop=stop_after_attempt(FETCH_RETRY_ATTEMPTS),
+                wait=wait_exponential(
+                    multiplier=1, min=FETCH_RETRY_WAIT_MIN_S, max=FETCH_RETRY_WAIT_MAX_S
+                ),
                 reraise=True,
             )
             async for attempt in retries:

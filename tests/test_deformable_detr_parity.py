@@ -20,6 +20,11 @@ from spotter_tpu.models.configs import DeformableDetrConfig
 from spotter_tpu.models.deformable_detr import DeformableDetrDetector
 
 
+# torch/transformers parity and train/e2e files are the slow tier (VERDICT r1
+# weak #6): the default `-m "not slow"` run must stay under 3 minutes.
+pytestmark = pytest.mark.slow
+
+
 def _tiny_hf_config(num_feature_levels=4, with_box_refine=False, two_stage=False):
     single = num_feature_levels == 1
     backbone = HFResNetConfig(

@@ -32,6 +32,16 @@ class FakeEngine:
         return [list(self.detections) for _ in images]
 
 
+@pytest.fixture(autouse=True)
+def _zero_retry_backoff(monkeypatch):
+    """Keep the 3-attempt retry CONTRACT but not its 4-10 s sleeps: the tests
+    assert attempt counts, not wall-clock backoff."""
+    import spotter_tpu.serving.detector as det_mod
+
+    monkeypatch.setattr(det_mod, "FETCH_RETRY_WAIT_MIN_S", 0.0)
+    monkeypatch.setattr(det_mod, "FETCH_RETRY_WAIT_MAX_S", 0.0)
+
+
 def _image_bytes(w=64, h=48):
     img = Image.fromarray(np.full((h, w, 3), 200, np.uint8))
     buf = BytesIO()

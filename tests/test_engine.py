@@ -68,6 +68,7 @@ def test_threshold_filters(engine):
     assert results == [[]]
 
 
+@pytest.mark.slow  # compile-heavy on 1-core CPU; full/CI run covers it
 def test_detr_family_end_to_end():
     """Tiny DETR through the full engine path (shortest-edge + mask + softmax)."""
     built = build_detector("facebook/detr-resnet-50")
@@ -89,6 +90,7 @@ def test_yolos_family_end_to_end():
     assert all(len(d) > 0 for d in results)
 
 
+@pytest.mark.slow  # compile-heavy on 1-core CPU; full/CI run covers it
 def test_owlvit_family_end_to_end(monkeypatch):
     """Tiny OWL-ViT: cached text-query embeds ride apply_kwargs; labels come
     from the deploy-time query list, not checkpoint metadata."""
@@ -106,6 +108,7 @@ def test_owlvit_family_end_to_end(monkeypatch):
     assert labels <= {"tv", "couch", "bed"} and labels
 
 
+@pytest.mark.slow  # compile-heavy on 1-core CPU; full/CI run covers it
 def test_deformable_detr_family_end_to_end():
     """Tiny Deformable-DETR through the full engine path (shortest-edge +
     mask + sigmoid top-k)."""
@@ -141,6 +144,7 @@ def test_dab_detr_registry_routing():
     assert type(built.module).__name__ == "DabDetrDetector"
 
 
+@pytest.mark.slow  # compile-heavy on 1-core CPU; full/CI run covers it
 def test_dab_detr_family_end_to_end():
     """Tiny DAB-DETR through the full engine path (shortest-edge + mask +
     sigmoid top-k)."""

@@ -1,0 +1,116 @@
+"""Multi-host bring-up tests: env contract, mesh-spec knob, and a real
+2-process jax.distributed smoke run on CPU.
+
+The reference has no multi-host path at all (SURVEY.md §2.3 row multi-host
+SPMD); this framework's is TPU_WORKER_* env -> jax.distributed.initialize
+(parallel/multihost.py), exercised here the way the k8s orchestration is
+exercised with a fake apiserver: two real local processes, no cluster.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from spotter_tpu.parallel import initialize_multihost, multihost_env_summary
+from spotter_tpu.serving.app import parse_mesh_spec
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_single_host_is_noop(monkeypatch):
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    assert initialize_multihost() is False
+    with pytest.raises(RuntimeError):
+        initialize_multihost(force=True)
+
+
+def test_env_summary_contract(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    summary = multihost_env_summary()
+    assert summary["TPU_WORKER_ID"] == "1"
+    assert summary["TPU_WORKER_HOSTNAMES"] == "h0,h1"
+    assert summary["SPOTTER_COORDINATOR_PORT"]  # always has a default
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("dp=4") == {"dp": 4, "tp": 1}
+    assert parse_mesh_spec("dp=4,tp=2") == {"dp": 4, "tp": 2}
+    assert parse_mesh_spec(" dp=2 , tp=1 ") == {"dp": 2, "tp": 1}
+    for bad in ("", "tp=2", "dp=0", "dp=x", "pp=2,dp=2"):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+
+
+_WORKER_SCRIPT = textwrap.dedent(
+    """
+    import numpy as np
+    from spotter_tpu.parallel import initialize_multihost
+
+    assert initialize_multihost() is True
+    import jax
+    from jax.experimental import multihost_utils
+
+    assert jax.process_count() == 2
+    gathered = multihost_utils.process_allgather(
+        np.array([jax.process_index()], np.int32)
+    )
+    assert sorted(int(v) for v in gathered.ravel()) == [0, 1], gathered
+    print(f"worker {jax.process_index()} OK")
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_distributed_smoke():
+    """Two real processes join one jax.distributed cluster over localhost and
+    run a cross-process allgather — the CPU stand-in for a 2-host DCN slice
+    (VERDICT r1 item 4's 'done' criterion)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for worker_id in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            TPU_WORKER_ID=str(worker_id),
+            TPU_WORKER_HOSTNAMES="127.0.0.1,127.0.0.1",
+            SPOTTER_COORDINATOR_PORT=str(port),
+            PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        )
+        # the virtual 8-device flag from conftest must not leak in: each
+        # worker contributes its own (single-CPU-device) local runtime
+        env["XLA_FLAGS"] = ""
+        # no TPU-tunnel plugin in the workers: its sitecustomize bootstrap
+        # (keyed off these vars) registers a PJRT plugin and its own
+        # distributed context, which would shadow the 2-process cluster
+        for var in (
+            "PJRT_LIBRARY_PATH",
+            "PJRT_NAMES_AND_LIBRARY_PATHS",
+            "PALLAS_AXON_POOL_IPS",
+        ):
+            env.pop(var, None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER_SCRIPT],
+                env=env,
+                cwd=REPO_ROOT,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"worker {i} OK" in out

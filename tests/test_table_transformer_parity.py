@@ -25,6 +25,11 @@ from spotter_tpu.models.detr import DetrDetector
 from spotter_tpu.models.registry import MODEL_REGISTRY
 
 
+# torch/transformers parity and train/e2e files are the slow tier (VERDICT r1
+# weak #6): the default `-m "not slow"` run must stay under 3 minutes.
+pytestmark = pytest.mark.slow
+
+
 def _tiny_hf_config():
     backbone = HFResNetConfig(
         embedding_size=8,
