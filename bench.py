@@ -42,43 +42,94 @@ def main() -> int:
     )
     args = parser.parse_args()
 
-    import jax
-
-    from spotter_tpu.models.configs import RTDETR_PRESETS
-    from spotter_tpu.models.rtdetr import RTDetrDetector
-    from spotter_tpu.ops.postprocess import sigmoid_topk_postprocess
     import os
 
-    from spotter_tpu.utils.precision import DTYPE_ENV, backbone_dtype, compute_dtype
+    import jax
 
     dev = jax.devices()[0]
-    cfg = RTDETR_PRESETS[args.model]
     # "mixed" is justified by v5e measurements only — TPU-likes get it as the
-    # default; CPU/GPU default to fp32
+    # default; CPU/GPU default to fp32. The policy env must be set BEFORE the
+    # spotter imports: ops.msda derives its MXU sampling precision from it at
+    # import time (1-pass under mixed/bf16, 6-pass exact under fp32).
     on_tpu = dev.platform in ("tpu", "axon")
-    policy = args.dtype or os.environ.get(DTYPE_ENV) or ("mixed" if on_tpu else "float32")
+    # safe pre-policy import: utils.precision never pulls in ops/models,
+    # whose import is what bakes the sampling precision from this env
+    from spotter_tpu.utils.precision import DTYPE_ENV
+
+    policy = args.dtype or os.environ.get(DTYPE_ENV) or (
+        "mixed" if on_tpu else "float32"
+    )
+    os.environ[DTYPE_ENV] = policy
+
+    from spotter_tpu.models.configs import RTDETR_PRESETS, DetrConfig, YolosConfig
+    from spotter_tpu.ops.postprocess import (
+        sigmoid_topk_postprocess,
+        softmax_postprocess,
+    )
+    from spotter_tpu.utils.precision import backbone_dtype, compute_dtype
+
     dtype = compute_dtype(policy)
-    module = RTDetrDetector(cfg, dtype=dtype, backbone_dtype=backbone_dtype(policy))
-    h = w = 640
+    bb_dtype = backbone_dtype(policy)
+    if args.model in RTDETR_PRESETS:
+        from spotter_tpu.models.rtdetr import RTDetrDetector
+
+        cfg = RTDETR_PRESETS[args.model]
+        module = RTDetrDetector(cfg, dtype=dtype, backbone_dtype=bb_dtype)
+        h = w = 640
+
+        def apply_post(params, pixels, sizes):
+            out = module.apply({"params": params}, pixels)
+            return sigmoid_topk_postprocess(
+                out["logits"], out["pred_boxes"], sizes, k=cfg.num_queries
+            )
+
+    elif args.model == "detr_resnet50":  # BASELINE config #3 (per chip)
+        from spotter_tpu.models.detr import DetrDetector
+
+        cfg = DetrConfig()  # defaults == facebook/detr-resnet-50
+        module = DetrDetector(cfg, dtype=dtype, backbone_dtype=bb_dtype)
+        h, w = 800, 1333  # shortest-edge landscape serving bucket
+
+        def apply_post(params, pixels, sizes):
+            out = module.apply(
+                {"params": params}, pixels, jnp.ones(pixels.shape[:3], jnp.float32)
+            )
+            return softmax_postprocess(out["logits"], out["pred_boxes"], sizes)
+
+    elif args.model == "yolos_base":  # BASELINE config #4 (per chip)
+        from spotter_tpu.models.yolos import YolosDetector
+
+        cfg = YolosConfig()  # defaults == hustvl/yolos-base
+        # ViT body follows the backbone dtype (bf16 under mixed): there is
+        # no CNN half, and the fp32 body is HBM-bound at 4300 tokens
+        module = YolosDetector(cfg, dtype=bb_dtype)
+        h, w = cfg.image_size
+
+        def apply_post(params, pixels, sizes):
+            out = module.apply({"params": params}, pixels)
+            return softmax_postprocess(out["logits"], out["pred_boxes"], sizes)
+
+    else:
+        raise SystemExit(
+            f"unknown --model {args.model!r}: expected one of "
+            f"{sorted(RTDETR_PRESETS)} + ['detr_resnet50', 'yolos_base']"
+        )
+
+    import jax.numpy as jnp  # noqa: E402  (after backend selection)
 
     params = module.init(jax.random.PRNGKey(0), np.zeros((1, h, w, 3), np.float32))[
         "params"
     ]
     params = jax.device_put(params, dev)
 
-    @jax.jit
-    def forward(params, pixels, sizes):
-        out = module.apply({"params": params}, pixels)
-        return sigmoid_topk_postprocess(
-            out["logits"], out["pred_boxes"], sizes, k=cfg.num_queries
-        )
+    forward = jax.jit(apply_post)
 
     best = {"images_per_sec": 0.0, "batch": 0, "p50_ms": 0.0}
     for batch in [int(b) for b in args.batches.split(",")]:
         pixels_np = np.random.default_rng(0).standard_normal((batch, h, w, 3)).astype(
             np.float32
         )
-        sizes_np = np.full((batch, 2), 640.0, np.float32)
+        sizes_np = np.tile(np.asarray([[h, w]], np.float32), (batch, 1))
         try:
             px = jax.device_put(pixels_np, dev)
             sz = jax.device_put(sizes_np, dev)
@@ -115,7 +166,7 @@ def main() -> int:
 
     result = {
         "metric": f"{args.model} images/sec/chip ({dev.platform}, "
-        f"{policy}, batch {best['batch']}, 640x640, "
+        f"{policy}, batch {best['batch']}, {h}x{w}, "
         f"p50 {best['p50_ms']:.2f} ms)",
         "value": round(best["images_per_sec"], 1),
         "unit": "images/sec",

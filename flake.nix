@@ -1,0 +1,45 @@
+{
+  # Hermetic dev environment for spotter-tpu (the reference pins its
+  # toolchain the same way: flake.nix:30-60 — go/python/uv/ruff; this build's
+  # toolchain is python/jax + cmake/ninja for the C++ control plane).
+  description = "spotter-tpu: TPU-native amenity-detection serving framework";
+
+  inputs = {
+    nixpkgs.url = "github:NixOS/nixpkgs/nixos-24.05";
+    flake-utils.url = "github:numtide/flake-utils";
+  };
+
+  outputs = { self, nixpkgs, flake-utils }:
+    flake-utils.lib.eachSystem [ "x86_64-linux" "aarch64-linux" ] (system:
+      let
+        pkgs = import nixpkgs { inherit system; };
+        python = pkgs.python312;
+      in {
+        devShells.default = pkgs.mkShell {
+          packages = [
+            python
+            pkgs.uv          # resolves pyproject deps (jax/flax wheels are not in nixpkgs at useful versions)
+            pkgs.ruff
+            pkgs.cmake
+            pkgs.ninja
+            pkgs.gcc13
+            pkgs.openssl     # manager TLS (dlopen'd libssl3)
+          ];
+
+          env = {
+            # same env contract as the serving bootstrap (serve.py:199 analog)
+            MODEL_NAME = "PekingU/rtdetr_v2_r101vd";
+            # keep uv on the nix-pinned interpreter
+            UV_PYTHON = "${python}/bin/python3.12";
+            UV_PYTHON_DOWNLOADS = "never";
+          };
+
+          shellHook = ''
+            echo "spotter-tpu dev shell"
+            echo "  fast suite : uv run --extra test pytest tests/          (-m 'not slow' is the default)"
+            echo "  full suite : uv run --all-extras pytest tests/ -m 'not tpu'"
+            echo "  manager    : cmake -S manager -B manager/build -G Ninja && cmake --build manager/build && ctest --test-dir manager/build"
+          '';
+        };
+      });
+}

@@ -187,10 +187,18 @@ HttpResponse HandleDeploy(const ManagerOptions& opts, K8sClient* client,
     return TextResponse(
         400, "Invalid topology '" + params["Topology"] +
                  "' (expected AxB or AxBxC positive integer dims)\n");
-  int num_workers = atoi(params["NumWorkers"].c_str());
+  const std::string& nw = params["NumWorkers"];
+  // digits-only AND length-capped: atoi/strtol overflow on giant numerals
+  // could otherwise wrap back into the accepted range
+  bool nw_numeric = !nw.empty() && nw.size() <= 3 &&
+                    nw.find_first_not_of("0123456789") == std::string::npos;
+  int num_workers = nw_numeric ? atoi(nw.c_str()) : 0;
   if (num_workers <= 0 || num_workers > 256)
-    return TextResponse(400, "Invalid numworkers '" + params["NumWorkers"] +
+    return TextResponse(400, "Invalid numworkers '" + nw +
                                  "' (expected 1-256)\n");
+  // re-render from the parsed value so the manifest can never carry a
+  // numeric-prefix string (e.g. "2abc") that the derived params ignored
+  params["NumWorkers"] = std::to_string(num_workers);
   int chips_per_host = total_chips <= 8 ? total_chips : 4;
   if (total_chips % chips_per_host)
     return TextResponse(

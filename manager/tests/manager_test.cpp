@@ -306,6 +306,13 @@ void TestDeployBadTopology() {
       MakeReq("POST", "/deploy", "dockerimage=img&topology=3x3"));
   EXPECT_EQ(resp.status, 400);
   EXPECT_CONTAINS(resp.body, "not schedulable");
+
+  // numeric-prefix worker count must 400, never render "2abc" into YAML
+  resp = spotter::HandleDeploy(
+      fx.opts, &client,
+      MakeReq("POST", "/deploy", "dockerimage=img&numworkers=2abc"));
+  EXPECT_EQ(resp.status, 400);
+  EXPECT_CONTAINS(resp.body, "numworkers");
 }
 
 void TestDeployValidation() {

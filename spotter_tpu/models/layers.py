@@ -13,6 +13,7 @@ Design notes (TPU-first):
 """
 
 import math
+import os
 from typing import Callable, Optional
 
 import jax
@@ -36,6 +37,67 @@ def get_activation(name: Optional[str]) -> Callable:
     if name is None:
         return lambda x: x
     return ACTIVATIONS[name]
+
+
+# Flash attention cutover: unmasked self-attention at or above this many
+# tokens runs the Pallas TPU flash kernel instead of materializing the
+# (B, H, S, S) score matrix. ViT-detector sequences make naive attention
+# HBM-catastrophic — yolos-base at 800x1344 is 4300 tokens, i.e. ~7 GB of
+# fp32 scores per batch-8 forward (measured 7.6 img/s naive). Short
+# sequences (AIFI's 400, decoder's 300) stay on the fused-XLA path, which
+# wins there and is the torch-parity-pinned reference. Process-start knob:
+# SPOTTER_TPU_FLASH_ATTN=0 disables.
+FLASH_ATTN_MIN_SEQ = 1024
+_FLASH_ATTN_ENABLED = os.environ.get("SPOTTER_TPU_FLASH_ATTN", "1") != "0"
+_FLASH_BLOCK = 512
+
+
+def flash_attention_enabled() -> bool:
+    """True when the flash path may be taken on this backend (shared by
+    every attention implementation in the model zoo)."""
+    return _FLASH_ATTN_ENABLED and jax.default_backend() == "tpu"
+
+
+def _flash_self_attention(q, k, v):
+    """(B, S, H, hd) pre-scaled q/k/v -> (B, S, H, hd) via the Pallas TPU
+    flash kernel. Pads S to the kernel block size; padded tokens live in a
+    different segment id, so they can never attend to or be attended by real
+    tokens (exact zeros-free equivalence with the naive path)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        SegmentIds,
+        flash_attention,
+    )
+
+    b, s, h, hd = q.shape
+    s_pad = -(-s // _FLASH_BLOCK) * _FLASH_BLOCK
+
+    def prep(x):
+        x = x.transpose(0, 2, 1, 3)  # (B, H, S, hd)
+        if s_pad != s:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+        return x
+
+    seg = jnp.broadcast_to(
+        (jnp.arange(s_pad) >= s).astype(jnp.int32)[None], (b, s_pad)
+    )
+    # Explicit uniform block sizes: the kernel's defaults picked a
+    # pathological schedule on v5e (64.6 ms vs 3.3 ms at yolos-base shapes,
+    # (8, 12, 4608, 64)); s_pad is a _FLASH_BLOCK multiple by construction.
+    blk = min(_FLASH_BLOCK, s_pad)
+    bs = BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk,
+        block_q_dkv=blk, block_k_dkv=blk,
+        block_q_dq=blk, block_k_dq=blk, block_k_major_dq=blk,
+    )
+    out = flash_attention(
+        prep(q), prep(k), prep(v),
+        segment_ids=SegmentIds(q=seg, kv=seg),
+        sm_scale=1.0,  # q arrives pre-scaled by head_dim**-0.5
+        block_sizes=bs,
+    )
+    return out[:, :, :s].transpose(0, 2, 1, 3)
 
 
 def inverse_sigmoid(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
@@ -172,6 +234,16 @@ class MultiHeadAttention(nn.Module):
         q = split(proj(q_in, "q_proj")) * (head_dim**-0.5)
         k = split(proj(k_in, "k_proj"))
         v = split(proj(v_in, "v_proj"))
+
+        if (
+            flash_attention_enabled()
+            and attention_mask is None
+            and key_value_states is None
+            and q.shape[1] >= FLASH_ATTN_MIN_SEQ
+        ):
+            out = _flash_self_attention(q, k, v)
+            out = out.reshape(*out.shape[:-2], self.embed_dim)
+            return proj(out, "out_proj")
 
         # (B, H, Tq, Tk)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)

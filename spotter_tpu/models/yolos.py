@@ -21,7 +21,13 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from spotter_tpu.models.configs import YolosConfig
-from spotter_tpu.models.layers import MLPHead, get_activation
+from spotter_tpu.models.layers import (
+    FLASH_ATTN_MIN_SEQ,
+    MLPHead,
+    _flash_self_attention,
+    flash_attention_enabled,
+    get_activation,
+)
 
 
 def _interpolate_patch_pos(
@@ -56,9 +62,17 @@ class YolosAttention(nn.Module):
         q = proj("query")
         k = proj("key")
         v = proj("value")
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (head_dim**-0.5)
-        weights = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        if flash_attention_enabled() and q.shape[1] >= FLASH_ATTN_MIN_SEQ:
+            # ViT-detector sequences (800x1344 -> 4300 tokens) make the
+            # naive path HBM-bound on the (B, H, S, S) scores; the flash
+            # kernel never materializes them (layers.py cutover notes)
+            out = _flash_self_attention(q * (head_dim**-0.5), k, v)
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (head_dim**-0.5)
+            weights = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+                self.dtype
+            )
+            out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
         out = out.reshape(*out.shape[:-2], cfg.hidden_size)
         return nn.Dense(cfg.hidden_size, dtype=self.dtype, name="out")(out)
 

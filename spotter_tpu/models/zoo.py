@@ -182,7 +182,11 @@ def tiny_yolos_config(num_labels: int = 80) -> YolosConfig:
 def _build_yolos(model_name: str) -> BuiltDetector:
     if os.environ.get(TINY_ENV):
         cfg = tiny_yolos_config()
-        module = YolosDetector(cfg, dtype=compute_dtype())
+        # The ViT body IS the HBM-bound half of this model (there is no CNN
+        # backbone), so it follows the backbone dtype: bf16 under "mixed"
+        # (measured v5e: the fp32 body is bandwidth-bound at 4300 tokens).
+        # Heads/logits/boxes stay fp32 inside the module.
+        module = YolosDetector(cfg, dtype=backbone_dtype())
         spec = PreprocessSpec(
             mode="fixed", size=cfg.image_size, mean=IMAGENET_MEAN, std=IMAGENET_STD
         )
@@ -192,7 +196,7 @@ def _build_yolos(model_name: str) -> BuiltDetector:
         from spotter_tpu.convert.loader import load_yolos_from_hf  # lazy: needs torch
 
         cfg, params = load_yolos_from_hf(model_name)
-        module = YolosDetector(cfg, dtype=compute_dtype())
+        module = YolosDetector(cfg, dtype=backbone_dtype())  # see tiny note
         # Warp-resize to the trained image size: position tables apply exactly
         # and every shape is static. (The torch processor instead pads to the
         # batch max and interpolates position tables per size — a recompile

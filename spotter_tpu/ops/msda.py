@@ -84,16 +84,17 @@ def msda_backend(override: str | None = None, batch_heads: int | None = None) ->
     is uniform, so it is currently unused."""
     del batch_heads
     name = (override or os.environ.get(MSDA_ENV, "auto")).strip().lower()
-    if name not in ("auto", "xla", "pallas", "pallas_gather"):
+    if name not in ("auto", "xla", "pallas", "pallas_sep", "pallas_gather"):
         raise ValueError(
-            f"{MSDA_ENV} must be auto|xla|pallas|pallas_gather, got {name!r}"
+            f"{MSDA_ENV} must be auto|xla|pallas|pallas_sep|pallas_gather, "
+            f"got {name!r}"
         )
     if name == "auto":
-        # TPU: the level-split one-hot kernel wins at every measured size
-        # (R101 full model, v5e: batch 8 71.2 ms vs 77.7 XLA; batch 16
-        # 145.2 ms vs 500.6 — XLA's gather lowering collapses above
-        # batch*heads ~96). CPU/GPU: always XLA (interpret-mode pallas
-        # would be pointlessly slow there).
+        # TPU: the merged-level one-hot kernel wins at every measured size
+        # (R101 decoder stack, v5e, 1-pass precision: 24 ms vs 36 ms for the
+        # separable-dot kernel and 205 ms for XLA row-gathers, whose
+        # lowering collapses above batch*heads ~96). CPU/GPU: always XLA
+        # (interpret-mode pallas would be pointlessly slow there).
         return "pallas" if jax.default_backend() == "tpu" else "xla"
     return name
 
@@ -289,8 +290,9 @@ pallas_deformable_sampling.defvjp(_msda_fwd, _msda_bwd)
 # 52.1/54.9 ms end-to-end. 640 wins on tile-count alignment: the stride-8
 # level's 80x80=6400 positions split into exactly 10 tiles (512 pads 12.5
 # ->13) while staying small enough that the hit table still prunes.
-# Q_TILE 128 and finer S tiles both lose (more revisits / more grid steps).
-S_TILE = 640
+# Process-start-only env overrides (like SPOTTER_TPU_MSDA_PRECISION) for
+# hardware tile sweeps; values are baked into compiled programs.
+S_TILE = int(os.environ.get("SPOTTER_TPU_MSDA_STILE", "640"))
 
 
 def _onehot_ref_math(rows, idx, w):
@@ -311,7 +313,7 @@ def _onehot_ref_math(rows, idx, w):
 # of neighboring queries samples a narrow band of each level's source and
 # most pairs are misses — the compare cost drops by the miss rate.
 
-Q_TILE = 64
+Q_TILE = int(os.environ.get("SPOTTER_TPU_MSDA_QTILE", "64"))
 
 
 def _mxu_precision() -> jax.lax.Precision:
@@ -326,8 +328,23 @@ def _mxu_precision() -> jax.lax.Precision:
     Read ONCE at import (module constant below) like the other env knobs:
     the value is baked into jit-compiled programs and is not part of any jit
     cache key, so changing the env after first trace could never take effect.
+
+    Default follows the serving precision policy: SPOTTER_TPU_DTYPE of
+    "mixed"/"bfloat16" already accepts bf16 rounding in the model, so the
+    sampling contraction defaults to the 1-pass MXU there; fp32 policies
+    keep the bit-faithful 6-pass default.
     """
-    name = os.environ.get("SPOTTER_TPU_MSDA_PRECISION", "highest").strip().lower()
+    from spotter_tpu.utils.precision import DTYPE_ENV  # no heavy imports
+
+    policy = os.environ.get(DTYPE_ENV, "").strip().lower()
+    policy_default = (
+        "default" if policy in ("mixed", "bfloat16", "bf16") else "highest"
+    )
+    name = (
+        os.environ.get("SPOTTER_TPU_MSDA_PRECISION", policy_default)
+        .strip()
+        .lower()
+    )
     table = {
         "highest": jax.lax.Precision.HIGHEST,
         "default": jax.lax.Precision.DEFAULT,
@@ -344,11 +361,49 @@ def _mxu_precision() -> jax.lax.Precision:
 MSDA_MXU_PRECISION = _mxu_precision()
 
 
-def _onehot_sparse_kernel(
-    mask_ref, idx_ref, w_ref, v_ref, out_ref, *, s_tile: int, precision
+
+
+# --- separable bilinear kernel ("pallas_sep"): MXU work instead of compares.
+#
+# The one-hot kernel's cost is the tile BUILD: 4 corners x P points x
+# (compare+select+add) over every (query, source) element — ~48 VPU ops per
+# element, measured ~80% of the op's time (the MXU contraction is a minority).
+# Bilinear weights are separable: w_corner = attn*(wy0|wy1)*(wx0|wx1), so per
+# point the whole (Q, S) one-hot block factors into wy(Q, rows) (x) wx(Q, W).
+# This kernel never builds the (Q, S) block at all:
+#
+#     g_p(q, r*hd)   = Wx_p(q, W) @ V_band(W, R*hd)          [MXU dot 1]
+#     m_p            = g_p * WyExpand_p(q, R*hd)             [VPU, 2 compares]
+#     out_p(q, hd)   = m_p @ SumBlock(R*hd, hd)              [MXU dot 2, 0/1]
+#
+# where V_band is the source band transposed to (W, R*hd) lanes r-major and
+# SumBlock is the constant 0/1 matrix summing each row group. Compares drop
+# from 16 full-width columns to 2 narrow + 2 full-width per point.
+# Out-of-band rows and out-of-bounds corners match nothing (unclamped
+# indices never equal an in-range lane id), so band masking and the
+# zeros-padding sampling semantics fall out of the compares for free.
+#
+# Status: measured SLOWER than the merged one-hot kernel on v5e at R101
+# decoder shapes (36 vs 24 ms per 6-layer stack at 1-pass precision — the
+# per-cell dot issues, not the compares, dominate there), so `auto` never
+# picks it; it stays as an explicit `SPOTTER_TPU_MSDA=pallas_sep` backend
+# for re-evaluation on hardware where the trade flips.
+
+SEP_R_BAND = 8  # rows per band when W <= 128; wider maps halve it
+
+
+def _sep_band_kernel(
+    mask_ref, xi_ref, xw0_ref, xw1_ref, yi_ref, yw0_ref, yw1_ref, v_ref,
+    out_ref, *, w_level: int, r_band: int, n_points: int, precision,
 ):
-    # mask_ref is the scalar-prefetch (SMEM) hit table, indexed by grid ids
-    qt, jc = idx_ref.shape[1], idx_ref.shape[2]
+    # All query-side blocks are point-STACKED columns (1, P*Q_TILE, 1) with
+    # point p owning sublane rows [p*Q_TILE, (p+1)*Q_TILE). The whole cell
+    # issues TWO dots — one (P*QT, W) x-contraction and one group-sum —
+    # instead of 2*P small ones; matmul issue latency was the measured
+    # bottleneck of the per-point variant.
+    pqt = xi_ref.shape[1]
+    qt = pqt // n_points
+    hd = out_ref.shape[-1]
     i, nq, ns = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(ns == 0)
@@ -357,64 +412,357 @@ def _onehot_sparse_kernel(
 
     @pl.when(mask_ref[i, nq, ns] != 0)
     def _():
-        s_off = ns * s_tile
-        col = jax.lax.broadcasted_iota(jnp.int32, (qt, s_tile), 1) + s_off
-        oh = jnp.zeros((qt, s_tile), jnp.float32)
-        idx = idx_ref[0]
-        w = w_ref[0]
-        for j in range(jc):
-            oh = oh + jnp.where(
-                col == idx[:, j : j + 1], w[:, j : j + 1].astype(jnp.float32), 0.0
-            )
-        acc = jnp.dot(
-            oh,
-            v_ref[0].astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-            precision=precision,
+        r0 = ns * r_band
+        cx = jax.lax.broadcasted_iota(jnp.int32, (pqt, w_level), 1)
+        x0 = xi_ref[0]  # (P*QT, 1) column, broadcast along lanes
+        wx = jnp.where(cx == x0, xw0_ref[0], 0.0) + jnp.where(
+            cx == x0 + 1, xw1_ref[0], 0.0
         )
-        out_ref[0] = out_ref[0] + acc.astype(out_ref.dtype)
+        g = jnp.dot(
+            wx, v_ref[0, 0], preferred_element_type=jnp.float32, precision=precision
+        )  # (P*QT, R*hd)
+        # lane r-id of the dot-1 output: lane = r*hd + hd_i
+        lane_r = jax.lax.broadcasted_iota(jnp.int32, (pqt, r_band * hd), 1) // hd
+        y0 = yi_ref[0] - r0
+        wy = jnp.where(lane_r == y0, yw0_ref[0], 0.0) + jnp.where(
+            lane_r == y0 + 1, yw1_ref[0], 0.0
+        )
+        m = g * wy
+        acc = m[:qt]
+        for p in range(1, n_points):  # static sublane slices: point group-sum
+            acc = acc + m[p * qt : (p + 1) * qt]
+        # constant 0/1 group-sum matrix (R*hd, hd): lane l feeds column l%hd
+        sum_block = (
+            jax.lax.broadcasted_iota(jnp.int32, (r_band * hd, hd), 0) % hd
+            == jax.lax.broadcasted_iota(jnp.int32, (r_band * hd, hd), 1)
+        ).astype(jnp.float32)
+        out = jnp.dot(
+            acc, sum_block, preferred_element_type=jnp.float32, precision=precision
+        )
+        out_ref[0] = out_ref[0] + out.astype(out_ref.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4,))
-def pallas_onehot_sampling_sparse(rows, idx, w, mask, interpret: bool = False):
-    """Block-sparse one-hot sampling.
+@partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11))
+def pallas_sep_sampling(
+    rows, xi, xw0, xw1, yi, yw0, yw1, mask,
+    w_level: int, r_band: int, n_points: int, interpret: bool = False,
+):
+    """Separable bilinear sampling over one level (point-stacked layout).
 
-    rows: (BH, S_pad, hd); idx/w: (BH, Qp, JC) with Qp a multiple of
-    Q_TILE; mask: (BH, Qp // Q_TILE, S_pad // S_TILE) int32 — nonzero where
-    any sample of the query tile lands in the source tile (must never
-    suppress a real hit; the dispatcher derives it from idx where w > 0).
-    Returns (BH, Qp, hd) fp32.
+    rows: (BH, n_bands, W, R*hd) band-transposed values; xi/yi:
+    (BH, n_qt*P*Q_TILE, 1) int32 column-vector UNCLAMPED level-local x0/y0,
+    point-major within each query tile; xw0/xw1/yw0/yw1: same-shape f32
+    corner-pair weights (attn folded into the x pair, validity folded by
+    zeroing); mask: (BH, n_qt, n_bands) int32 hit table. Returns
+    (BH, n_qt*Q_TILE, hd) f32.
     """
-    bh, s_pad, hd = rows.shape
-    _, qp, jc = idx.shape
-    n_s = s_pad // S_TILE
-    n_qt = qp // Q_TILE
-    # env parsed here (dispatch), not in the kernel body: typos fail fast
-    # with a readable error instead of mid-trace, and the environment isn't
-    # re-read per kernel trace
-    kernel = partial(_onehot_sparse_kernel, s_tile=S_TILE, precision=MSDA_MXU_PRECISION)
-    # upper bound: the mask is runtime data, so masked-off tiles can't be
-    # subtracted statically; the true cost is this times the hit fraction
-    flops = 2 * bh * n_s * (qp * S_TILE * hd + jc * qp * S_TILE)
+    bh, n_bands, w_lvl, rhd = rows.shape
+    hd = rhd // r_band
+    n_qt = mask.shape[1]
+    pqt = xi.shape[1] // n_qt
+    qp = n_qt * (pqt // n_points)
+    kernel = partial(
+        _sep_band_kernel,
+        w_level=w_level,
+        r_band=r_band,
+        n_points=n_points,
+        precision=MSDA_MXU_PRECISION,
+    )
+    flops = 2 * bh * n_bands * (n_qt * pqt * w_lvl * rhd + qp * rhd * hd)
+    qblock = [
+        pl.BlockSpec(
+            (1, pqt, 1), lambda i, nq, s, *_: (i, nq, 0), memory_space=pltpu.VMEM
+        )
+        for _ in range(6)
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,  # the hit table rides in SMEM
-        grid=(bh, n_qt, n_s),
-        in_specs=[
+        num_scalar_prefetch=1,
+        grid=(bh, n_qt, n_bands),
+        in_specs=qblock
+        + [
             pl.BlockSpec(
-                (1, Q_TILE, jc), lambda i, nq, s, *_: (i, nq, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, Q_TILE, jc), lambda i, nq, s, *_: (i, nq, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, S_TILE, hd), lambda i, nq, s, *_: (i, s, 0),
+                (1, 1, w_lvl, rhd), lambda i, nq, s, *_: (i, s, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
             (1, Q_TILE, hd), lambda i, nq, s, *_: (i, nq, 0),
+            memory_space=pltpu.VMEM,
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, qp, hd), jnp.float32),
+        grid_spec=grid_spec,
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=rows.size * 4 * n_qt
+            + (xi.size + yi.size) * 4
+            + 4 * xw0.size * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(mask, xi, xw0, xw1, yi, yw0, yw1, rows)
+
+
+def _sep_ref_math(rows, xi, xw0, xw1, yi, yw0, yw1, r_band, n_points):
+    """jnp reference of the separable kernel (VJP + parity tests).
+
+    Same contraction order (x-dot, y-weight, point sum, group sum in fp32),
+    so under HIGHEST precision it matches the kernel bit-for-bit-ish.
+    """
+    bh, n_bands, w_lvl, rhd = rows.shape
+    hd = rhd // r_band
+    qt = Q_TILE
+    n_qt = xi.shape[1] // (n_points * qt)
+    cx = jnp.arange(w_lvl, dtype=jnp.int32)
+    rr = jnp.arange(n_bands * r_band, dtype=jnp.int32)
+    # rows (BH, bands, W, R, hd) -> (BH, bands*R rows, W, hd)
+    v = rows.reshape(bh, n_bands, w_lvl, r_band, hd).transpose(0, 1, 3, 2, 4)
+    v = v.reshape(bh, n_bands * r_band, w_lvl, hd)
+
+    def unstack(a):  # (BH, n_qt*P*QT, 1) -> (BH, P, n_qt*QT)
+        return a.reshape(bh, n_qt, n_points, qt).transpose(0, 2, 1, 3).reshape(
+            bh, n_points, n_qt * qt
+        )
+
+    xi_u, yi_u = unstack(xi), unstack(yi)
+    xw0_u, xw1_u = unstack(xw0), unstack(xw1)
+    yw0_u, yw1_u = unstack(yw0), unstack(yw1)
+    out = jnp.zeros((bh, n_qt * qt, hd), jnp.float32)
+    for p in range(n_points):
+        wx = (
+            (cx[None, None, :] == xi_u[:, p, :, None]) * xw0_u[:, p, :, None]
+            + (cx[None, None, :] == xi_u[:, p, :, None] + 1) * xw1_u[:, p, :, None]
+        ).astype(jnp.float32)
+        wy = (
+            (rr[None, None, :] == yi_u[:, p, :, None]) * yw0_u[:, p, :, None]
+            + (rr[None, None, :] == yi_u[:, p, :, None] + 1) * yw1_u[:, p, :, None]
+        ).astype(jnp.float32)
+        g = jnp.einsum("bqw,brwd->bqrd", wx, v)  # (BH, Qp, rows, hd)
+        out = out + (g * wy[..., None]).sum(axis=2)
+    return out
+
+
+def _sep_fwd(rows, xi, xw0, xw1, yi, yw0, yw1, mask, w_level, r_band, n_points, interpret):
+    return (
+        pallas_sep_sampling(
+            rows, xi, xw0, xw1, yi, yw0, yw1, mask, w_level, r_band, n_points, interpret
+        ),
+        (rows, xi, xw0, xw1, yi, yw0, yw1),
+    )
+
+
+def _sep_bwd(w_level, r_band, n_points, interpret, res, g):
+    rows, xi, xw0, xw1, yi, yw0, yw1 = res
+    _, vjp = jax.vjp(
+        lambda r, a0, a1, b0, b1: _sep_ref_math(
+            r, xi, a0, a1, yi, b0, b1, r_band, n_points
+        ),
+        rows, xw0, xw1, yw0, yw1,
+    )
+    d_rows, d_xw0, d_xw1, d_yw0, d_yw1 = vjp(g)
+    return d_rows, None, d_xw0, d_xw1, None, d_yw0, d_yw1, None
+
+
+pallas_sep_sampling.defvjp(_sep_fwd, _sep_bwd)
+
+
+def _sep_level_dispatch(
+    value_l,  # (BH, S_l, hd) this level's rows (unpadded)
+    loc_l,  # (B, Q, H, P, 2) this level's sample points in [0, 1]
+    attn_l,  # (B, Q, H, P)
+    lh: int,
+    lw: int,
+    method: str,
+    interpret: bool,
+) -> jnp.ndarray:
+    """Prepare separable operands for one level and run the kernel."""
+    b, q, h_axis, pts, _ = loc_l.shape
+    bh = b * h_axis
+    hd = value_l.shape[-1]
+    qp = -(-q // Q_TILE) * Q_TILE
+
+    # band geometry: R_BAND rows per grid step, W on the dot's K axis
+    r_band = SEP_R_BAND if lw <= 128 else max(1, SEP_R_BAND // 2)
+    n_bands = -(-lh // r_band)
+
+    attn_f = attn_l.astype(jnp.float32)
+    if method == "discrete":
+        # nearest-integer, border-clamped (RT-DETRv2 discrete semantics):
+        # single active corner, always valid after the clamp
+        x0 = jnp.clip(jnp.floor(loc_l[..., 0] * lw + 0.5), 0, lw - 1)
+        y0 = jnp.clip(jnp.floor(loc_l[..., 1] * lh + 0.5), 0, lh - 1)
+        xw0, xw1 = attn_f, jnp.zeros_like(attn_f)
+        yw0, yw1 = jnp.ones_like(attn_f), jnp.zeros_like(attn_f)
+    else:
+        gx = loc_l[..., 0] * lw - 0.5
+        gy = loc_l[..., 1] * lh - 0.5
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        fx = (gx - x0).astype(jnp.float32)
+        fy = (gy - y0).astype(jnp.float32)
+        # validity folds into the weights; indices stay UNCLAMPED so an
+        # out-of-bounds corner can never equal an in-range lane/row id
+        vx0 = ((x0 >= 0) & (x0 <= lw - 1)).astype(jnp.float32)
+        vx1 = (x0 + 1 <= lw - 1).astype(jnp.float32) * (x0 + 1 >= 0)
+        vy0 = ((y0 >= 0) & (y0 <= lh - 1)).astype(jnp.float32)
+        vy1 = (y0 + 1 <= lh - 1).astype(jnp.float32) * (y0 + 1 >= 0)
+        xw0 = (1.0 - fx) * vx0 * attn_f  # attn folded into the x pair
+        xw1 = fx * vx1 * attn_f
+        yw0 = (1.0 - fy) * vy0
+        yw1 = fy * vy1
+
+    n_qt = qp // Q_TILE
+
+    def stack(a, pad_value=0):  # (B, Q, H, P) -> (BH, n_qt, P*Q_TILE)
+        a = a.transpose(0, 2, 1, 3).reshape(bh, q, pts)
+        if qp != q:
+            a = jnp.pad(
+                a, ((0, 0), (0, qp - q), (0, 0)), constant_values=pad_value
+            )
+        # point-major within each query tile, as a column vector (the
+        # kernel's (P*QT, 1) sublane layout)
+        return a.reshape(bh, n_qt, Q_TILE, pts).transpose(0, 1, 3, 2).reshape(
+            bh, n_qt * pts * Q_TILE, 1
+        )
+
+    xi = stack(x0.astype(jnp.int32), pad_value=-7)
+    yi = stack(y0.astype(jnp.int32), pad_value=-7)
+    xw0_s, xw1_s = stack(xw0), stack(xw1)
+    yw0_s, yw1_s = stack(yw0), stack(yw1)
+
+    # band-transposed values: (BH, S_l, hd) -> (BH, n_bands, W, R*hd) r-major
+    h_pad = n_bands * r_band
+    v = value_l.reshape(bh, lh, lw, hd)
+    if h_pad != lh:
+        v = jnp.pad(v, ((0, 0), (0, h_pad - lh), (0, 0), (0, 0)))
+    v = v.reshape(bh, n_bands, r_band, lw, hd).transpose(0, 1, 3, 2, 4)
+    rows = v.reshape(bh, n_bands, lw, r_band * hd)
+
+    # hit table: which row bands does each query tile touch? (from y0/y0+1
+    # where the corner weight can be nonzero — never suppresses a real hit).
+    # A corner's y-weight gates BOTH its row candidates (wy0 -> y0 row,
+    # wy1 -> y0+1 row); x-weights don't matter, the band spans the width.
+    band_ids = jnp.arange(n_bands, dtype=jnp.int32)
+    y_hits = [
+        jnp.where(yw0_s > 0, yi // r_band, -1),
+        jnp.where(yw1_s > 0, (yi + 1) // r_band, -1),
+    ]
+    # columns (BH, n_qt*P*QT, 1) -> per-query-tile rows (BH, n_qt, 2*P*QT)
+    bands = jnp.concatenate(y_hits, axis=-1).reshape(bh, n_qt, -1)
+    mask = (bands[..., None] == band_ids).any(axis=2).astype(jnp.int32)
+    return pallas_sep_sampling(
+        rows, xi, xw0_s, xw1_s, yi, yw0_s, yw1_s, mask, lw, r_band, pts, interpret
+    )[:, :q]
+
+
+# --- merged-level one-hot kernel: ONE pallas_call per MSDA op.
+#
+# Measured on v5e (R101 decoder shapes): each pallas_call costs ~0.9 ms of
+# launch overhead and each grid step ~0.5 us even when the hit mask skips
+# the body — with 3 per-level calls x 6 decoder layers, launches alone were
+# ~16 ms of the ~30 ms sampling stack. This kernel runs every level's source
+# tiles in one grid: the s axis walks the CONCATENATED per-level padded
+# spans, index maps route the per-level (Q_TILE, jc) idx/w blocks by which
+# level the s-step belongs to (static thresholds -> plain id arithmetic),
+# and the output accumulates across all levels' steps, so the per-level
+# partial sums come free.
+
+
+def _onehot_merged_kernel(
+    mask_ref, idx_ref, w_ref, v_ref, out_ref,
+    *, s_tile: int, level_spans: tuple, precision,
+):
+    # Grid is (bh, n_qt) ONLY: the s-walk over every level's tiles is a
+    # static Python unroll over slices of the fully-fetched value block.
+    # Measured on v5e, each pipelined grid step costs ~0.7 us of machinery
+    # even when the hit mask skips the body — a (bh, n_qt, n_s) grid spent
+    # ~3 ms/layer on machinery alone at R101 decoder shapes (4480 steps);
+    # this layout pays it for 320. The s-loop being in-kernel also means the
+    # value block is fetched once per (bh, nq), and each unrolled step knows
+    # its level STATICALLY (no index-map routing).
+    qt, jc = idx_ref.shape[2], idx_ref.shape[3]
+    i, nq = pl.program_id(0), pl.program_id(1)
+
+    out_ref[0] = jnp.zeros_like(out_ref[0])
+    step0 = 0
+    for lvl, span in enumerate(level_spans):
+        idx = idx_ref[0, lvl]
+        w = w_ref[0, lvl]
+        for k in range(span):
+            ns = step0 + k
+
+            @pl.when(mask_ref[i, nq, ns] != 0)
+            def _(ns=ns, k=k, idx=idx, w=w):
+                col = jax.lax.broadcasted_iota(jnp.int32, (qt, s_tile), 1) + (
+                    k * s_tile
+                )
+                oh = jnp.zeros((qt, s_tile), jnp.float32)
+                for j in range(jc):
+                    oh = oh + jnp.where(
+                        col == idx[:, j : j + 1],
+                        w[:, j : j + 1].astype(jnp.float32),
+                        0.0,
+                    )
+                acc = jnp.dot(
+                    oh,
+                    v_ref[0, ns * s_tile : (ns + 1) * s_tile].astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                    precision=precision,
+                )
+                out_ref[0] = out_ref[0] + acc.astype(out_ref.dtype)
+
+        step0 += span
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def pallas_onehot_sampling_merged(
+    rows, idx, w, mask, level_spans: tuple, interpret: bool = False
+):
+    """Block-sparse one-hot sampling over ALL levels in one pallas_call.
+
+    rows: (BH, n_s_total*S_TILE, hd) — per-level spans padded to S_TILE
+    multiples and concatenated; idx/w: (BH, L, Qp, jc) level-LOCAL corner
+    indices/weights (invalid slots negative/zero); mask: (BH, Qp//Q_TILE,
+    n_s_total) hit table over the concatenated s-steps; level_spans: static
+    per-level s-step counts (sum = n_s_total). Returns (BH, Qp, hd) fp32.
+    """
+    bh, s_cat, hd = rows.shape
+    _, n_levels, qp, jc = idx.shape
+    n_s = s_cat // S_TILE
+    n_qt = qp // Q_TILE
+    assert sum(level_spans) == n_s, (level_spans, n_s)
+    kernel = partial(
+        _onehot_merged_kernel,
+        s_tile=S_TILE,
+        level_spans=tuple(level_spans),
+        precision=MSDA_MXU_PRECISION,
+    )
+    flops = 2 * bh * n_s * (qp * S_TILE * hd + jc * qp * S_TILE)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_qt),
+        in_specs=[
+            pl.BlockSpec(
+                (1, n_levels, Q_TILE, jc),
+                lambda i, nq, *_: (i, 0, nq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, n_levels, Q_TILE, jc),
+                lambda i, nq, *_: (i, 0, nq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            # the whole concatenated value block rides along per bh; the
+            # index map ignores nq, so the pipeline fetches it once per i
+            pl.BlockSpec(
+                (1, s_cat, hd), lambda i, nq, *_: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, Q_TILE, hd), lambda i, nq, *_: (i, nq, 0),
             memory_space=pltpu.VMEM,
         ),
     )
@@ -431,23 +779,36 @@ def pallas_onehot_sampling_sparse(rows, idx, w, mask, interpret: bool = False):
     )(mask, idx, w, rows)
 
 
-def _onehot_sparse_fwd(rows, idx, w, mask, interpret):
+def _onehot_merged_ref(rows, idx, w, level_spans):
+    """Dense reference for the merged kernel (identical primal -> exact VJP)."""
+    bh, _, hd = rows.shape
+    out = None
+    step0 = 0
+    for lvl, span in enumerate(level_spans):
+        rows_l = rows[:, step0 * S_TILE : (step0 + span) * S_TILE]
+        step0 += span
+        part = _onehot_ref_math(rows_l, idx[:, lvl], w[:, lvl])
+        out = part if out is None else out + part
+    return out
+
+
+def _onehot_merged_fwd(rows, idx, w, mask, level_spans, interpret):
     return (
-        pallas_onehot_sampling_sparse(rows, idx, w, mask, interpret),
+        pallas_onehot_sampling_merged(rows, idx, w, mask, level_spans, interpret),
         (rows, idx, w),
     )
 
 
-def _onehot_sparse_bwd(interpret, res, g):
-    # the mask never suppresses a real hit, so the dense reference computes
-    # the identical primal — its VJP is exact for the sparse kernel too
+def _onehot_merged_bwd(level_spans, interpret, res, g):
     rows, idx, w = res
-    _, vjp = jax.vjp(lambda r, ww: _onehot_ref_math(r, idx, ww), rows, w)
+    _, vjp = jax.vjp(
+        lambda r, ww: _onehot_merged_ref(r, idx, ww, level_spans), rows, w
+    )
     d_rows, d_w = vjp(g)
     return d_rows, None, d_w, None
 
 
-pallas_onehot_sampling_sparse.defvjp(_onehot_sparse_fwd, _onehot_sparse_bwd)
+pallas_onehot_sampling_merged.defvjp(_onehot_merged_fwd, _onehot_merged_bwd)
 
 
 def deformable_sampling(
@@ -478,6 +839,45 @@ def deformable_sampling(
 
     chosen = msda_backend(backend, batch_heads=b * h_axis)
     interp = bool(interpret) if interpret is not None else False
+
+    def locality_perm():
+        """Quantized mean-sample-position sort key, y-major (source tiles
+        are horizontal bands of each level's row-major span). Shared by both
+        kernel backends so their tiling behavior can't desynchronize."""
+        mean_xy = loc.mean(axis=(2, 3))  # (B, Q, 2) in [0, 1]
+        key = (
+            jnp.clip((mean_xy[..., 1] * 64).astype(jnp.int32), 0, 63) * 64
+            + jnp.clip((mean_xy[..., 0] * 64).astype(jnp.int32), 0, 63)
+        )
+        p = jnp.argsort(key, axis=1)  # (B, Q)
+        return p, jnp.argsort(p, axis=1)
+
+    if chosen == "pallas_sep":
+        # Separable bilinear kernel, one call per level (level-split as in
+        # the one-hot kernel). Sorted queries make a Q_TILE of neighbors
+        # touch few row bands, so the hit table prunes; the sort/unsort are
+        # two Q-row permutes.
+        perm, inv_perm = locality_perm()
+        loc_s = jnp.take_along_axis(loc, perm[:, :, None, None, None], axis=1)
+        attn_s = jnp.take_along_axis(attn, perm[:, :, None, None], axis=1)
+
+        rows_all = value.transpose(0, 2, 1, 3).reshape(b * h_axis, s, hd)
+        offs = _level_offsets(spatial_shapes)
+        out = None
+        for lvl, (lh, lw) in enumerate(spatial_shapes):
+            part = _sep_level_dispatch(
+                rows_all[:, offs[lvl] : offs[lvl] + lh * lw],
+                loc_s[:, :, :, lvl * num_points : (lvl + 1) * num_points, :],
+                attn_s[:, :, :, lvl * num_points : (lvl + 1) * num_points],
+                lh,
+                lw,
+                method,
+                interp,
+            )
+            out = part if out is None else out + part
+        out = out.reshape(b, h_axis, q, hd)
+        out = jnp.take_along_axis(out, inv_perm[:, None, :, None], axis=2)
+        return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)
     if chosen == "pallas":
         # Level-split: a sample only ever lands inside its own level's span
         # of the flat source (block-diagonal one-hot), so each per-level
@@ -489,16 +889,7 @@ def deformable_sampling(
         # kernel skips (query-tile, source-tile) pairs with no hit.
         jc = 4 * lp
         qp = -(-q // Q_TILE) * Q_TILE
-
-        # locality sort key: quantized mean sample position, y-major (the
-        # flat source is row-major, so source tiles are horizontal bands)
-        mean_xy = loc.mean(axis=(2, 3))  # (B, Q, 2) in [0, 1]
-        key = (
-            jnp.clip((mean_xy[..., 1] * 64).astype(jnp.int32), 0, 63) * 64
-            + jnp.clip((mean_xy[..., 0] * 64).astype(jnp.int32), 0, 63)
-        )
-        perm = jnp.argsort(key, axis=1)  # (B, Q)
-        inv_perm = jnp.argsort(perm, axis=1)
+        perm, inv_perm = locality_perm()
 
         idx_q = idx.reshape(b, h_axis, 4, lp, q).transpose(0, 1, 4, 2, 3)
         w_q = w.reshape(b, h_axis, 4, lp, q).transpose(0, 1, 4, 2, 3)
@@ -515,7 +906,11 @@ def deformable_sampling(
         offs = _level_offsets(spatial_shapes)
         points = lp // len(spatial_shapes)
         n_qt = qp // Q_TILE
-        out = None
+        # Per-level blocks, all feeding ONE merged pallas_call (launch
+        # overhead per call is ~0.9 ms on v5e — one call per op, not per
+        # level): spans padded to S_TILE and concatenated, per-level
+        # idx/w stacked, hit masks concatenated along the s-step axis.
+        rows_cat, idx_levels, w_levels, masks, spans = [], [], [], [], []
         for lvl, (lh, lw) in enumerate(spatial_shapes):
             s_l = lh * lw
             rows_l = rows_all[:, offs[lvl] : offs[lvl] + s_l]
@@ -538,8 +933,19 @@ def deformable_sampling(
                 .any(axis=(2, 3))
                 .astype(jnp.int32)
             )
-            part = pallas_onehot_sampling_sparse(rows_l, idx_l, w_l, mask, interp)
-            out = part if out is None else out + part
+            rows_cat.append(rows_l)
+            idx_levels.append(idx_l)
+            w_levels.append(w_l)
+            masks.append(mask)
+            spans.append(n_s)
+        out = pallas_onehot_sampling_merged(
+            jnp.concatenate(rows_cat, axis=1),
+            jnp.stack(idx_levels, axis=1),
+            jnp.stack(w_levels, axis=1),
+            jnp.concatenate(masks, axis=2),
+            tuple(spans),
+            interp,
+        )
         out = out[:, :q].reshape(b, h_axis, q, hd)
         out = jnp.take_along_axis(out, inv_perm[:, None, :, None], axis=2)
         return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)

@@ -1,0 +1,99 @@
+"""Golden-box integration test on the REAL RT-DETRv2-R101 checkpoint.
+
+The reference's only end-to-end accuracy anchor (test_serve.py:246-326): run
+the full detector pipeline on the checked-in fixture image
+(tests/test_data/test_pic.jpg, the reference's own fixture — goldens are
+defined against exactly these pixels) and assert the exact amenity set
+{kitchen, oven, chair} with per-label boxes within ±1.0 px of the reference's
+golden values. Passing this on the converted Flax checkpoint turns the
+repo's "±1 px" docstring claims from extrapolation into tested fact.
+
+Needs the real `PekingU/rtdetr_v2_r101vd` weights: marked integration +
+network + slow, and skips cleanly when HF is unreachable and no local cache
+exists (this build box has zero egress).
+"""
+
+import asyncio
+import os
+from pathlib import Path
+from unittest.mock import AsyncMock
+
+import numpy as np
+import pytest
+
+MODEL_NAME = "PekingU/rtdetr_v2_r101vd"
+IMAGE = Path(__file__).parent / "test_data" / "test_pic.jpg"
+
+# Reference golden outputs (test_serve.py:293-300): amenity set and
+# [xmin, ymin, xmax, ymax] per label, tolerance abs=1.0 px.
+GOLDEN = {
+    "kitchen": [305.8487, 331.8141, 352.8352, 360.6238],
+    "oven": [265.7876, 368.4354, 362.2969, 505.2321],
+    "chair": [587.5251, 441.0653, 796.3880, 714.2424],
+}
+
+pytestmark = [pytest.mark.integration, pytest.mark.network, pytest.mark.slow]
+
+
+def _build_real_detector():
+    """Real-weight build; skip (not fail) when weights are unreachable."""
+    assert os.environ.get("SPOTTER_TPU_TINY") in (None, "", "0"), (
+        "golden test must run the real checkpoint; unset SPOTTER_TPU_TINY"
+    )
+    from spotter_tpu.models import build_detector
+
+    try:
+        return build_detector(MODEL_NAME)
+    except Exception as exc:  # HF hub unreachable / no cache on a zero-egress box
+        pytest.skip(f"real checkpoint unavailable offline: {type(exc).__name__}: {exc}")
+
+
+def _detect(built):
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.engine.engine import InferenceEngine
+    from spotter_tpu.serving.detector import AmenitiesDetector
+
+    engine = InferenceEngine(built, threshold=0.5, batch_buckets=(1,))
+    resp_ok = AsyncMock()
+    resp_ok.content = IMAGE.read_bytes()
+    resp_ok.raise_for_status = lambda: None
+    client = AsyncMock()
+    client.get.return_value = resp_ok
+    detector = AmenitiesDetector(engine, MicroBatcher(engine, max_delay_ms=1.0), client)
+    return asyncio.run(detector.detect({"image_urls": ["local://test_pic.jpg"]}))
+
+
+def _assert_golden(result):
+    from spotter_tpu.schemas import DetectionSuccessResult
+
+    (image_result,) = result.images
+    assert isinstance(image_result, DetectionSuccessResult), image_result
+    assert len(image_result.labeled_image_base64) > 500
+    detected = {d.label for d in image_result.detections}
+    assert detected == set(GOLDEN), detected
+    matched = set()
+    for det in image_result.detections:
+        want = GOLDEN.get(det.label)
+        if want is not None and det.box == pytest.approx(want, abs=1.0):
+            matched.add(det.label)
+    assert matched == set(GOLDEN), (matched, image_result.detections)
+    return {d.label: d.box for d in image_result.detections}
+
+
+def test_golden_boxes_real_checkpoint(tmp_path, monkeypatch):
+    """Converted Flax R101 reproduces the reference's golden boxes, and the
+    Orbax cache round-trip reproduces them identically."""
+    from spotter_tpu.convert import loader
+
+    monkeypatch.setenv(loader.CACHE_ENV, str(tmp_path / "cache"))
+    built = _build_real_detector()
+    boxes_first = _assert_golden(_detect(built))
+
+    # Second build must hit the Orbax cache (no torch conversion) and the
+    # cached params must reproduce bit-identical boxes.
+    from spotter_tpu.models import build_detector
+
+    built_cached = build_detector(MODEL_NAME)
+    boxes_cached = _assert_golden(_detect(built_cached))
+    for label, box in boxes_first.items():
+        np.testing.assert_array_equal(np.asarray(box), np.asarray(boxes_cached[label]))

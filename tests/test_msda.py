@@ -65,7 +65,7 @@ def test_xla_path_matches_grid_sample_reference():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
 
 
-@pytest.mark.parametrize("backend", ["pallas", "pallas_gather"])
+@pytest.mark.parametrize("backend", ["pallas", "pallas_sep", "pallas_gather"])
 def test_pallas_interpret_matches_xla(backend):
     value, loc, attn = _random_inputs(1)
     got = deformable_sampling(
@@ -85,6 +85,10 @@ def test_discrete_method_parity():
     pg = deformable_sampling(
         value, loc, attn, SHAPES, P, method="discrete",
         backend="pallas_gather", interpret=True,
+    )
+    ps = deformable_sampling(
+        value, loc, attn, SHAPES, P, method="discrete",
+        backend="pallas_sep", interpret=True,
     )
     # original discrete formulation from the module (pre-fusion)
     sampled = []
@@ -109,6 +113,7 @@ def test_discrete_method_parity():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
     np.testing.assert_allclose(np.asarray(pal), np.asarray(ref), atol=1e-5)
     np.testing.assert_allclose(np.asarray(pg), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ps), np.asarray(ref), atol=1e-5)
 
 
 def test_pallas_gather_gradients_match_xla():
@@ -150,6 +155,9 @@ def test_onehot_gradients_match_xla():
     gx_v, gx_a = jax.grad(loss("xla"), argnums=(0, 1))(value, jnp.asarray(attn))
     np.testing.assert_allclose(np.asarray(gp_v), np.asarray(gx_v), atol=1e-4)
     np.testing.assert_allclose(np.asarray(gp_a), np.asarray(gx_a), atol=1e-4)
+    gs_v, gs_a = jax.grad(loss("pallas_sep"), argnums=(0, 1))(value, jnp.asarray(attn))
+    np.testing.assert_allclose(np.asarray(gs_v), np.asarray(gx_v), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gs_a), np.asarray(gx_a), atol=1e-4)
 
 
 @pytest.mark.tpu

@@ -1,0 +1,94 @@
+"""Component-level timing on the real chip: where do the milliseconds go?
+
+Times (a) backbone alone, (b) full model at decoder_layers=1/6, (c) the MSDA
+sampling op standalone at decoder shapes, under both MXU precisions. Uses the
+bench.py device_get methodology (block_until_ready over-reports through the
+tunnel).
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def timeit(fn, *args, iters=12):
+    import jax
+
+    jax.device_get(fn(*args))  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.device_get(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--parts", default="backbone,full,msda")
+    args = parser.parse_args()
+    parts = args.parts.split(",")
+
+    import jax
+    import jax.numpy as jnp
+
+    from spotter_tpu.models.configs import RTDETR_PRESETS
+    from spotter_tpu.models.rtdetr import RTDetrDetector
+    from spotter_tpu.models.resnet import ResNetBackbone
+
+    b, h, w = args.batch, 640, 640
+    cfg = RTDETR_PRESETS["rtdetr_v2_r101vd"]
+    px = jnp.asarray(
+        np.random.default_rng(0).standard_normal((b, h, w, 3)), jnp.float32
+    )
+
+    if "backbone" in parts:
+        for dt in (jnp.float32, jnp.bfloat16):
+            bb = ResNetBackbone(cfg.backbone, dtype=dt)
+            params = bb.init(jax.random.PRNGKey(0), px[:1])["params"]
+            # fetch a SCALAR: multi-MB feature maps through the tunnel would
+            # dominate the timing (~100 MB/s link)
+            f = jax.jit(
+                lambda p, x: sum(
+                    jnp.sum(t.astype(jnp.float32)) for t in bb.apply({"params": p}, x)
+                )
+            )
+            ms = timeit(f, params, px)
+            print(f"backbone {dt.__name__}: {ms:.1f} ms")
+
+    if "full" in parts:
+        for layers in (1, 6):
+            c = cfg.replace(decoder_layers=layers) if hasattr(cfg, "replace") else None
+            if c is None:
+                import dataclasses
+                c = dataclasses.replace(cfg, decoder_layers=layers)
+            mod = RTDetrDetector(c, dtype=jnp.float32, backbone_dtype=jnp.bfloat16)
+            params = mod.init(jax.random.PRNGKey(0), px[:1])["params"]
+            f = jax.jit(lambda p, x: mod.apply({"params": p}, x)["pred_boxes"])
+            ms = timeit(f, params, px)
+            print(f"full mixed decoder_layers={layers}: {ms:.1f} ms")
+
+    if "msda" in parts:
+        from spotter_tpu.ops import msda as M
+
+        heads, hd, q_n, pts = 8, 32, 300, 4
+        shapes = ((80, 80), (40, 40), (20, 20))
+        s = sum(hh * ww for hh, ww in shapes)
+        rng = np.random.default_rng(0)
+        value = jnp.asarray(rng.standard_normal((b, s, heads, hd)), jnp.float32)
+        loc = jnp.asarray(rng.random((b, q_n, heads, len(shapes) * pts, 2)), jnp.float32)
+        attn = jax.nn.softmax(
+            jnp.asarray(rng.standard_normal((b, q_n, heads, len(shapes) * pts)), jnp.float32)
+        )
+
+        f = jax.jit(
+            lambda v, l, a: M.deformable_sampling(v, l, a, shapes, pts, backend="pallas")
+        )
+        ms = timeit(f, value, loc, attn)
+        print(f"msda pallas single call (precision={M.MSDA_MXU_PRECISION}): {ms:.2f} ms "
+              f"(x6 layers = {6*ms:.1f} ms)")
+
+
+if __name__ == "__main__":
+    main()
