@@ -36,9 +36,9 @@ def main() -> int:
         "--dtype",
         default=None,
         help="precision policy (float32|bfloat16|mixed); default SPOTTER_TPU_DTYPE "
-        "if set, else mixed on TPU (bf16 backbone convs + fp32 transformer/"
-        "decoder — the measured-fastest config, 58.0 vs 62.8 ms at R101 "
-        "batch 8) and fp32 on CPU/GPU",
+        "if set, else bfloat16 on TPU (measured fastest with the sampling "
+        "kernel: 232 vs 211 img/s over mixed at R101 batch 8) and fp32 on "
+        "CPU/GPU",
     )
     args = parser.parse_args()
 
@@ -47,17 +47,20 @@ def main() -> int:
     import jax
 
     dev = jax.devices()[0]
-    # "mixed" is justified by v5e measurements only — TPU-likes get it as the
-    # default; CPU/GPU default to fp32. The policy env must be set BEFORE the
-    # spotter imports: ops.msda derives its MXU sampling precision from it at
-    # import time (1-pass under mixed/bf16, 6-pass exact under fp32).
+    # "bfloat16" is justified by v5e measurements only (232 vs 211 img/s over
+    # "mixed" at R101 batch 8 — with the sampling kernel the decoder is
+    # HBM-bound and bf16 activations win; round-1's opposite result was an
+    # artifact of the gather path) — TPU-likes get it as the default; CPU/GPU
+    # default to fp32. The policy env must be set BEFORE the spotter imports:
+    # ops.msda derives its MXU sampling precision from it at import time
+    # (1-pass under mixed/bf16, 6-pass exact under fp32).
     on_tpu = dev.platform in ("tpu", "axon")
     # safe pre-policy import: utils.precision never pulls in ops/models,
     # whose import is what bakes the sampling precision from this env
     from spotter_tpu.utils.precision import DTYPE_ENV
 
     policy = args.dtype or os.environ.get(DTYPE_ENV) or (
-        "mixed" if on_tpu else "float32"
+        "bfloat16" if on_tpu else "float32"
     )
     os.environ[DTYPE_ENV] = policy
 

@@ -187,9 +187,12 @@ HttpResponse HandleDeploy(const ManagerOptions& opts, K8sClient* client,
     return TextResponse(
         400, "Invalid topology '" + params["Topology"] +
                  "' (expected AxB or AxBxC positive integer dims)\n");
-  const std::string& nw = params["NumWorkers"];
-  // digits-only AND length-capped: atoi/strtol overflow on giant numerals
-  // could otherwise wrap back into the accepted range
+  std::string nw = params["NumWorkers"];
+  // digits-only AND length-capped after stripping leading zeros:
+  // atoi/strtol overflow on giant numerals could otherwise wrap back into
+  // the accepted range, while "0004" must keep meaning 4
+  size_t nz = nw.find_first_not_of('0');
+  if (nz != std::string::npos && nz > 0) nw = nw.substr(nz);
   bool nw_numeric = !nw.empty() && nw.size() <= 3 &&
                     nw.find_first_not_of("0123456789") == std::string::npos;
   int num_workers = nw_numeric ? atoi(nw.c_str()) : 0;

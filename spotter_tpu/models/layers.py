@@ -58,7 +58,7 @@ def flash_attention_enabled() -> bool:
     return _FLASH_ATTN_ENABLED and jax.default_backend() == "tpu"
 
 
-def _flash_self_attention(q, k, v):
+def flash_self_attention(q, k, v):
     """(B, S, H, hd) pre-scaled q/k/v -> (B, S, H, hd) via the Pallas TPU
     flash kernel. Pads S to the kernel block size; padded tokens live in a
     different segment id, so they can never attend to or be attended by real
@@ -241,7 +241,7 @@ class MultiHeadAttention(nn.Module):
             and key_value_states is None
             and q.shape[1] >= FLASH_ATTN_MIN_SEQ
         ):
-            out = _flash_self_attention(q, k, v)
+            out = flash_self_attention(q, k, v)
             out = out.reshape(*out.shape[:-2], self.embed_dim)
             return proj(out, "out_proj")
 

@@ -290,10 +290,16 @@ class OwlViTDetector(nn.Module):
 
     config: OwlViTConfig
     dtype: jnp.dtype = jnp.float32
+    # "mixed" policy: the vision tower is the HBM-bound ViT half (owlv2:
+    # 3600 patch tokens) and follows the backbone dtype like yolos' body;
+    # text tower + heads keep the compute dtype (fp32 by default).
+    vision_dtype: Optional[jnp.dtype] = None
 
     def setup(self) -> None:
         cfg = self.config
-        self.vision = OwlViTVisionTower(cfg.vision, dtype=self.dtype)
+        self.vision = OwlViTVisionTower(
+            cfg.vision, dtype=self.vision_dtype or self.dtype
+        )
         self.text = OwlViTTextTower(cfg.text, dtype=self.dtype)
         self.text_projection = nn.Dense(
             cfg.projection_dim, use_bias=False, dtype=self.dtype

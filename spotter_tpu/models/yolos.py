@@ -24,7 +24,7 @@ from spotter_tpu.models.configs import YolosConfig
 from spotter_tpu.models.layers import (
     FLASH_ATTN_MIN_SEQ,
     MLPHead,
-    _flash_self_attention,
+    flash_self_attention,
     flash_attention_enabled,
     get_activation,
 )
@@ -66,7 +66,7 @@ class YolosAttention(nn.Module):
             # ViT-detector sequences (800x1344 -> 4300 tokens) make the
             # naive path HBM-bound on the (B, H, S, S) scores; the flash
             # kernel never materializes them (layers.py cutover notes)
-            out = _flash_self_attention(q * (head_dim**-0.5), k, v)
+            out = flash_self_attention(q * (head_dim**-0.5), k, v)
         else:
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (head_dim**-0.5)
             weights = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(

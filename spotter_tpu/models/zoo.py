@@ -258,7 +258,9 @@ def _build_owlvit(model_name: str) -> BuiltDetector:
     prompts = [f"a photo of a {label}" for label in labels]
     if os.environ.get(TINY_ENV):
         cfg = tiny_owlvit_config()
-        module = OwlViTDetector(cfg, dtype=compute_dtype())
+        module = OwlViTDetector(
+            cfg, dtype=compute_dtype(), vision_dtype=backbone_dtype()
+        )
         spec = PreprocessSpec(mode="fixed", size=(32, 32), mean=CLIP_MEAN, std=CLIP_STD)
         rng = np.random.default_rng(0)
         t = cfg.text.max_position_embeddings
@@ -279,7 +281,9 @@ def _build_owlvit(model_name: str) -> BuiltDetector:
         )
 
         cfg, params = load_owlvit_from_hf(model_name)
-        module = OwlViTDetector(cfg, dtype=compute_dtype())
+        module = OwlViTDetector(
+            cfg, dtype=compute_dtype(), vision_dtype=backbone_dtype()
+        )
         spec = OWLV2_SPEC if cfg.objectness else OWLVIT_SPEC
         ids, mask = owlvit_tokenize(model_name, prompts, cfg.text.max_position_embeddings)
     # TPU-first split: the text tower runs ONCE here; the serving hot path is

@@ -1,17 +1,22 @@
 """Compute-precision policy for the serving stack.
 
 The reference runs torch fp32 on CPU/MPS (serve.py:61) and has no precision
-knob. On TPU, XLA's default matmul precision already routes fp32 matmuls and
-convolutions through the MXU's native bfloat16 passes (fp32 accumulate), so
-keeping activations fp32 is the *fast* configuration: measured on v5e,
-R101 batch-8 runs 78 ms/call in fp32 vs 106 ms with bf16 activations — the
-explicit bf16 casts break elementwise fusions in the gather-heavy decoder
-and outweigh the backbone's bandwidth win (22.3 -> 17.9 ms). The default is
-therefore float32 everywhere; `SPOTTER_TPU_DTYPE=bfloat16` opts a deployment
-into bf16 activations (halved HBM traffic — worth re-measuring at larger
-batches or on HBM-tighter chips). Under bf16 the models keep
-box-refinement arithmetic and head outputs fp32 so the ±1 px golden-box
-contract (test_serve.py:296-300) still holds.
+knob. Three policies:
+
+- "float32" (serving default): exact, torch-parity-pinned end to end — XLA
+  still routes fp32 matmuls/convs through the MXU's bf16 passes, so this is
+  not slow, just bandwidth-heavier.
+- "mixed": bf16 for the HBM-bound halves (ResNet backbones, the YOLOS ViT
+  body, the OWL-ViT vision tower), fp32 for the detection transformers.
+- "bfloat16": bf16 activations everywhere — the measured-fastest config on
+  v5e with the MSDA sampling kernel (232 vs 211 img/s over "mixed", R101
+  batch 8; the decoder is HBM-bound once sampling stops being
+  compare-bound). Round 1 measured the opposite because the gather-path
+  decoder lost its elementwise fusions under explicit casts.
+
+Under every policy the models keep box-refinement arithmetic and head
+outputs fp32 so the ±1 px golden-box contract (test_serve.py:296-300) is
+only exercised end-to-end at "float32", and bf16 box drift stays bounded.
 """
 
 import os
