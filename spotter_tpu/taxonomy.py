@@ -5,12 +5,18 @@ the same 22 COCO labels map to the same amenity strings; labels outside the
 mapping are dropped from results (serve.py:123-126).
 """
 
+# Wire-contract constant: every key/value pair must match the reference
+# exactly (changing one changes /detect responses). Rough grouping: appliance
+# and tableware classes signal a kitchen (tableware collapses to the generic
+# "kitchen" string; "sink" is ambiguous between kitchen and bathroom and is
+# reported as itself); furniture classes map to living/bedroom amenities
+# with two renames (couch->sofa, tv->TV); "toilet" stands in for a bathroom
+# and desk-peripheral classes for a workspace; "car" is read as parking.
 AMENITIES_MAPPING: dict[str, str] = {
-    # Kitchen
     "refrigerator": "refrigerator",
     "oven": "oven",
     "microwave": "microwave",
-    "sink": "sink",  # Could be kitchen or bathroom
+    "sink": "sink",
     "dining table": "dining area",
     "toaster": "toaster",
     "wine glass": "kitchen",
@@ -19,16 +25,12 @@ AMENITIES_MAPPING: dict[str, str] = {
     "knife": "kitchen",
     "spoon": "kitchen",
     "bowl": "kitchen",
-    # Living Area
     "tv": "TV",
     "couch": "sofa",
     "chair": "chair",
-    # Bedroom
     "bed": "bed",
-    # Bathroom
     "toilet": "bathroom",
     "hair drier": "hair dryer",
-    # Workspace indicator
     "laptop": "workspace",
     "mouse": "workspace",
     "keyboard": "workspace",
