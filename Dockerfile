@@ -12,13 +12,21 @@ ENV MODEL_NAME=${MODEL_NAME}
 WORKDIR /app
 COPY pyproject.toml ./
 COPY spotter_tpu ./spotter_tpu
+COPY tools/golden_check.py ./tools/golden_check.py
+COPY tests/test_data/test_pic.jpg ./tests/test_data/test_pic.jpg
 
 # Cache path must be pinned BEFORE the bake step so build-time conversion and
 # runtime load agree on it (the ray base image runs as user `ray`).
 ENV SPOTTER_TPU_CACHE=/home/ray/.cache/spotter_tpu
 
+# The golden_check step is the accuracy gate (reference test_serve.py:246-326
+# runs in its CI): it reloads the just-baked Orbax cache, detects on the
+# reference fixture, logs every box, and FAILS THE BUILD on >±1 px drift —
+# a bad conversion can never ship. Runs on the build host's CPU backend
+# (JAX_PLATFORMS=cpu: no TPU at image-build time).
 RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
     && pip install --no-cache-dir .[torch] \
     && spotter-tpu-download \
+    && JAX_PLATFORMS=cpu python tools/golden_check.py \
     && pip uninstall -y torch transformers timm accelerate
 EXPOSE 8000

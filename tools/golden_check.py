@@ -1,0 +1,95 @@
+"""Build-time golden-box gate: validate the BAKED checkpoint, fail the image.
+
+The reference's only end-to-end accuracy proof is its real-checkpoint
+integration test (reference test_serve.py:246-326): golden boxes for
+{kitchen, oven, chair} on tests/spotter/test_data/test_pic.jpg, ±1.0 px.
+CI runs the pytest version (tests/test_golden_boxes.py); this script is the
+Docker-build gate (VERDICT r2 next #3b): it runs AFTER `spotter-tpu-download`
+has converted torch→Flax into SPOTTER_TPU_CACHE, builds the detector from
+that exact baked cache (the artifact pods will load), detects on the fixture,
+prints every box into the build log, and exits nonzero on any mismatch — so
+an image with a bad conversion can never be pushed.
+
+Usage: python tools/golden_check.py [--image tests/test_data/test_pic.jpg]
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+from pathlib import Path
+from unittest.mock import AsyncMock
+
+# run as `python tools/golden_check.py` (e.g. in the Docker build): the
+# script dir is on sys.path, the repo root (spotter_tpu package) is not
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Golden values published by the reference (test_serve.py:293-300):
+# amenity label -> [xmin, ymin, xmax, ymax], tolerance ±1.0 px.
+GOLDEN = {
+    "kitchen": [305.8487, 331.8141, 352.8352, 360.6238],
+    "oven": [265.7876, 368.4354, 362.2969, 505.2321],
+    "chair": [587.5251, 441.0653, 796.3880, 714.2424],
+}
+TOLERANCE_PX = 1.0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--image",
+        default=str(Path(__file__).parent.parent / "tests" / "test_data" / "test_pic.jpg"),
+    )
+    parser.add_argument("--model", default=os.environ.get("MODEL_NAME", ""))
+    args = parser.parse_args()
+    if not args.model:
+        print("golden_check: MODEL_NAME not set", file=sys.stderr)
+        return 2
+    if args.model != "PekingU/rtdetr_v2_r101vd":
+        # goldens are only published for the default checkpoint; other bakes
+        # still get the conversion itself exercised by spotter-tpu-download
+        print(f"golden_check: no goldens for {args.model}; skipping gate")
+        return 0
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.engine.engine import InferenceEngine
+    from spotter_tpu.models import build_detector
+    from spotter_tpu.schemas import DetectionSuccessResult
+    from spotter_tpu.serving.detector import AmenitiesDetector
+
+    built = build_detector(args.model)  # loads the baked Orbax cache
+    engine = InferenceEngine(built, threshold=0.5, batch_buckets=(1,))
+    resp = AsyncMock()
+    resp.content = Path(args.image).read_bytes()
+    resp.raise_for_status = lambda: None
+    client = AsyncMock()
+    client.get.return_value = resp
+    detector = AmenitiesDetector(engine, MicroBatcher(engine, max_delay_ms=1.0), client)
+    result = asyncio.run(detector.detect({"image_urls": ["baked://test_pic.jpg"]}))
+
+    (image_result,) = result.images
+    if not isinstance(image_result, DetectionSuccessResult):
+        print(f"golden_check: detection errored: {image_result}", file=sys.stderr)
+        return 1
+    boxes = {d.label: d.box for d in image_result.detections}
+    print(f"golden_check: detected {boxes}")
+    failures = []
+    if set(boxes) != set(GOLDEN):
+        failures.append(f"label set {sorted(boxes)} != golden {sorted(GOLDEN)}")
+    for label, want in GOLDEN.items():
+        got = boxes.get(label)
+        if got is None:
+            continue
+        drift = max(abs(a - b) for a, b in zip(got, want))
+        print(f"golden_check: {label}: {got} vs {want} (max drift {drift:.3f} px)")
+        if drift > TOLERANCE_PX:
+            failures.append(f"{label} drifted {drift:.3f} px > {TOLERANCE_PX}")
+    if failures:
+        print("golden_check: FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    print("golden_check: PASS — baked checkpoint reproduces the reference goldens")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
