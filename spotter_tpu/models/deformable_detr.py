@@ -42,6 +42,7 @@ from spotter_tpu.models.layers import (
 )
 from spotter_tpu.models.resnet import ResNetBackbone
 from spotter_tpu.ops.msda import deformable_sampling
+from spotter_tpu.ops.topk import top_k as fast_top_k
 
 
 def sine_position_from_mask_offset(
@@ -497,7 +498,8 @@ class DeformableDetrDetector(nn.Module):
         enc_coord_logits = delta + output_proposals
 
         k = cfg.two_stage_num_proposals
-        _, topk_ind = jax.lax.top_k(enc_class[..., 0].astype(jnp.float32), k)
+        # radix-bisect top-k (ops/topk.py): lax.top_k result, no S-wide sort
+        _, topk_ind = fast_top_k(enc_class[..., 0].astype(jnp.float32), k)
         topk_coords_logits = jnp.take_along_axis(
             enc_coord_logits, topk_ind[..., None], axis=1
         )

@@ -36,6 +36,7 @@ from spotter_tpu.models.layers import (
 )
 from spotter_tpu.models.resnet import ResNetBackbone
 from spotter_tpu.ops.msda import deformable_sampling
+from spotter_tpu.ops.topk import top_k as fast_top_k
 
 
 def generate_anchors(
@@ -380,7 +381,9 @@ class RTDetrDetector(nn.Module):
             + anchors
         )
 
-        _, topk_ind = jax.lax.top_k(enc_class.max(-1), cfg.num_queries)
+        # radix-bisect top-k (ops/topk.py): same result as lax.top_k without
+        # the S-wide sort (measured ~3.3 ms of the batch-8 forward on v5e)
+        _, topk_ind = fast_top_k(enc_class.max(-1), cfg.num_queries)
         gather = lambda arr: jnp.take_along_axis(arr, topk_ind[..., None], axis=1)
         reference_logits = gather(enc_coord_logits)
         enc_topk_logits = gather(enc_class)

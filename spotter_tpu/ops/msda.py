@@ -294,6 +294,13 @@ pallas_deformable_sampling.defvjp(_msda_fwd, _msda_bwd)
 # hardware tile sweeps; values are baked into compiled programs.
 S_TILE = int(os.environ.get("SPOTTER_TPU_MSDA_STILE", "640"))
 
+# Locality sort ON by default: sorting queries by quantized mean sample
+# position makes the block-sparse hit table prune (neighbor queries share
+# source bands). SPOTTER_TPU_MSDA_SORT=0 uses the identity permutation —
+# for hardware where the argsort + q-row permutes cost more than the
+# sparsity saves (process-start-only knob like the tile sizes).
+MSDA_SORT = os.environ.get("SPOTTER_TPU_MSDA_SORT", "1") != "0"
+
 
 def _onehot_ref_math(rows, idx, w):
     """jnp reference for the one-hot kernel (VJP + interpret parity).
@@ -843,7 +850,12 @@ def deformable_sampling(
     def locality_perm():
         """Quantized mean-sample-position sort key, y-major (source tiles
         are horizontal bands of each level's row-major span). Shared by both
-        kernel backends so their tiling behavior can't desynchronize."""
+        kernel backends so their tiling behavior can't desynchronize.
+        (None, None) when MSDA_SORT is off — callers skip the permutes
+        entirely (the sort is a sparsity heuristic, never a correctness
+        requirement)."""
+        if not MSDA_SORT:
+            return None, None
         mean_xy = loc.mean(axis=(2, 3))  # (B, Q, 2) in [0, 1]
         key = (
             jnp.clip((mean_xy[..., 1] * 64).astype(jnp.int32), 0, 63) * 64
@@ -858,8 +870,10 @@ def deformable_sampling(
         # touch few row bands, so the hit table prunes; the sort/unsort are
         # two Q-row permutes.
         perm, inv_perm = locality_perm()
-        loc_s = jnp.take_along_axis(loc, perm[:, :, None, None, None], axis=1)
-        attn_s = jnp.take_along_axis(attn, perm[:, :, None, None], axis=1)
+        loc_s, attn_s = loc, attn
+        if perm is not None:
+            loc_s = jnp.take_along_axis(loc, perm[:, :, None, None, None], axis=1)
+            attn_s = jnp.take_along_axis(attn, perm[:, :, None, None], axis=1)
 
         rows_all = value.transpose(0, 2, 1, 3).reshape(b * h_axis, s, hd)
         offs = _level_offsets(spatial_shapes)
@@ -876,7 +890,8 @@ def deformable_sampling(
             )
             out = part if out is None else out + part
         out = out.reshape(b, h_axis, q, hd)
-        out = jnp.take_along_axis(out, inv_perm[:, None, :, None], axis=2)
+        if inv_perm is not None:
+            out = jnp.take_along_axis(out, inv_perm[:, None, :, None], axis=2)
         return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)
     if chosen == "pallas":
         # Level-split: a sample only ever lands inside its own level's span
@@ -893,11 +908,12 @@ def deformable_sampling(
 
         idx_q = idx.reshape(b, h_axis, 4, lp, q).transpose(0, 1, 4, 2, 3)
         w_q = w.reshape(b, h_axis, 4, lp, q).transpose(0, 1, 4, 2, 3)
-        psel = perm[:, None, :, None, None]
-        idx_q = jnp.take_along_axis(idx_q, psel, axis=2).reshape(
-            b * h_axis, q, jc
-        )
-        w_q = jnp.take_along_axis(w_q, psel, axis=2).reshape(b * h_axis, q, jc)
+        if perm is not None:
+            psel = perm[:, None, :, None, None]
+            idx_q = jnp.take_along_axis(idx_q, psel, axis=2)
+            w_q = jnp.take_along_axis(w_q, psel, axis=2)
+        idx_q = idx_q.reshape(b * h_axis, q, jc)
+        w_q = w_q.reshape(b * h_axis, q, jc)
         if qp != q:  # padded queries: idx 0, weight 0 -> zero rows, no hits
             idx_q = jnp.pad(idx_q, ((0, 0), (0, qp - q), (0, 0)))
             w_q = jnp.pad(w_q, ((0, 0), (0, qp - q), (0, 0)))
@@ -947,7 +963,8 @@ def deformable_sampling(
             interp,
         )
         out = out[:, :q].reshape(b, h_axis, q, hd)
-        out = jnp.take_along_axis(out, inv_perm[:, None, :, None], axis=2)
+        if inv_perm is not None:
+            out = jnp.take_along_axis(out, inv_perm[:, None, :, None], axis=2)
         return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)
     if chosen == "pallas_gather":
         vt = value.transpose(0, 2, 3, 1)  # (B, H, hd, S): spatial on lanes

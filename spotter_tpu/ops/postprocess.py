@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spotter_tpu.ops.boxes import center_to_corners, scale_boxes
+from spotter_tpu.ops.topk import top_k as fast_top_k
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -37,7 +38,9 @@ def sigmoid_topk_postprocess(
     """
     b, q, c = logits.shape
     scores = jax.nn.sigmoid(logits).reshape(b, q * c)
-    top_scores, top_idx = jax.lax.top_k(scores, k)
+    # radix-bisect selection on TPU (ops/topk.py): identical result to
+    # lax.top_k without the (B, Q*C)-wide sort
+    top_scores, top_idx = fast_top_k(scores, k)
     labels = top_idx % c
     query_idx = top_idx // c
     boxes = jnp.take_along_axis(pred_boxes, query_idx[..., None], axis=1)

@@ -1,0 +1,70 @@
+"""bisect_top_k == lax.top_k, bitwise (values AND indices, incl. ties)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spotter_tpu.ops.topk import bisect_top_k
+
+
+@pytest.mark.parametrize("shape,k", [((4, 97), 13), ((2, 8400), 300), ((1, 50), 50)])
+def test_matches_lax_top_k_random(shape, k):
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal(shape).astype(np.float32)
+    )
+    v_ref, i_ref = jax.lax.top_k(x, k)
+    v, i = jax.jit(bisect_top_k, static_argnums=1)(x, k)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_matches_with_massive_ties():
+    # quantized scores: many exact ties across the k boundary
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(np.round(rng.standard_normal((3, 500)) * 4) / 4, jnp.float32)
+    v_ref, i_ref = jax.lax.top_k(x, 40)
+    v, i = bisect_top_k(x, 40)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_matches_with_negatives_zeros_infs():
+    x = jnp.asarray(
+        [[0.0, -0.0, 1.5, -1.5, np.inf, -np.inf, 2.0, 2.0, -3.0, 0.25]],
+        jnp.float32,
+    )
+    v_ref, i_ref = jax.lax.top_k(x, 6)
+    v, i = bisect_top_k(x, 6)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_bf16_inputs_match():
+    x = jnp.asarray(
+        np.random.default_rng(2).standard_normal((2, 300)), jnp.bfloat16
+    )
+    v_ref, i_ref = jax.lax.top_k(x, 25)
+    v, i = bisect_top_k(x, 25)
+    np.testing.assert_array_equal(
+        np.asarray(v, np.float32), np.asarray(v_ref, np.float32)
+    )
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_grad_flows_through_values():
+    # selection indices are integer outputs; values must be differentiable
+    # like lax.top_k's (gather from input)
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 64)), jnp.float32)
+
+    def f(x):
+        v, _ = bisect_top_k(x, 5)
+        return (v * jnp.arange(1.0, 6.0)).sum()
+
+    def f_ref(x):
+        v, _ = jax.lax.top_k(x, 5)
+        return (v * jnp.arange(1.0, 6.0)).sum()
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(f)(x)), np.asarray(jax.grad(f_ref)(x)), atol=1e-6
+    )

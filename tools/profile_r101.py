@@ -1,0 +1,230 @@
+"""Round-3 ablation profile: where do R101 batch-8 milliseconds go under bf16?
+
+Chained-dispatch timing (device_get bounds; per-call tunnel RTT amortized).
+Stages: full forward at decoder_layers 1/3/6 (slope = per-layer cost,
+intercept = backbone+encoder+selection), backbone alone, standalone top-k
+selection, standalone MSDA sampling, standalone pallas launch probe.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+# run as `python tools/profile_r101.py`: script dir is on sys.path, repo root
+# (the spotter_tpu package) is not
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, *args, iters=20):
+    import jax
+
+    jax.device_get(fn(*args))  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.device_get(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument(
+        "--parts", default="layers,backbone,topk,msda,launch"
+    )
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument(
+        "--layers-set", default="1,3,6", help="decoder_layers values for --parts layers"
+    )
+    args = parser.parse_args()
+    parts = args.parts.split(",")
+
+    import os
+
+    os.environ["SPOTTER_TPU_DTYPE"] = args.dtype
+
+    import jax
+    import jax.numpy as jnp
+
+    from spotter_tpu.models.configs import RTDETR_PRESETS
+    from spotter_tpu.models.rtdetr import RTDetrDetector
+    from spotter_tpu.models.resnet import ResNetBackbone
+    from spotter_tpu.utils.precision import backbone_dtype, compute_dtype
+
+    dt = compute_dtype(args.dtype)
+    bdt = backbone_dtype(args.dtype)
+    b, h, w = args.batch, 640, 640
+    cfg = RTDETR_PRESETS["rtdetr_v2_r101vd"]
+    px = jnp.asarray(
+        np.random.default_rng(0).standard_normal((b, h, w, 3)), jnp.float32
+    )
+
+    if "layers" in parts:
+        import dataclasses
+
+        for layers in (int(v) for v in args.layers_set.split(",")):
+            c = dataclasses.replace(cfg, decoder_layers=layers)
+            mod = RTDetrDetector(c, dtype=dt, backbone_dtype=bdt)
+            params = mod.init(jax.random.PRNGKey(0), px[:1])["params"]
+            f = jax.jit(lambda p, x, m=mod: m.apply({"params": p}, x)["pred_boxes"])
+            ms = timeit(f, params, px)
+            print(f"full {args.dtype} decoder_layers={layers}: {ms:.2f} ms")
+
+    if "dec_ablate" in parts:
+        # split the 2.56 ms/layer decoder slope: how much is the sampling op?
+        # monkeypatch the sampling core to a cheap stand-in (value mean over
+        # S broadcast per query) and re-measure the slope.
+        import dataclasses
+
+        import spotter_tpu.models.rtdetr as rtdetr_mod
+
+        real_sampling = rtdetr_mod.deformable_sampling
+
+        def fake_sampling(value, loc, attn, spatial_shapes, num_points, method="default"):
+            b_, s_, h_, hd_ = value.shape
+            q_ = loc.shape[1]
+            pooled = value.mean(axis=1).reshape(b_, 1, h_ * hd_)
+            # keep loc/attn (and the Denses producing them) alive in the graph
+            keep = (attn.sum() + loc.sum()).astype(value.dtype) * 0
+            return jnp.broadcast_to(pooled, (b_, q_, h_ * hd_)) + keep
+
+        rtdetr_mod.deformable_sampling = fake_sampling
+        try:
+            for layers in (1, 6):
+                c = dataclasses.replace(cfg, decoder_layers=layers)
+                mod = RTDetrDetector(c, dtype=dt, backbone_dtype=bdt)
+                params = mod.init(jax.random.PRNGKey(0), px[:1])["params"]
+                f = jax.jit(lambda p, x, m=mod: m.apply({"params": p}, x)["pred_boxes"])
+                ms = timeit(f, params, px)
+                print(f"full NO-SAMPLING decoder_layers={layers}: {ms:.2f} ms")
+        finally:
+            rtdetr_mod.deformable_sampling = real_sampling
+
+    if "kernel_ablate" in parts:
+        # keep the FULL XLA-side prep (bilinear idx/w, sort, permutes, hit
+        # tables, value transpose) but stub the pallas contraction itself:
+        # the delta vs the real model isolates in-kernel time from prep time.
+        from spotter_tpu.ops import msda as M
+
+        real_kernel = M.pallas_onehot_sampling_merged
+
+        def fake_kernel(rows, idx, w, mask, level_spans, interpret=False):
+            qp = idx.shape[2]
+            keep = 1e-30 * (
+                w.sum() + idx.sum().astype(jnp.float32) + mask.sum().astype(jnp.float32)
+            )
+            return rows[:, :qp].astype(jnp.float32) + keep
+
+        M.pallas_onehot_sampling_merged = fake_kernel
+        try:
+            mod = RTDetrDetector(cfg, dtype=dt, backbone_dtype=bdt)
+            params = mod.init(jax.random.PRNGKey(0), px[:1])["params"]
+            f = jax.jit(lambda p, x, m=mod: m.apply({"params": p}, x)["pred_boxes"])
+            ms = timeit(f, params, px)
+            print(f"full PREP-ONLY (kernel stubbed): {ms:.2f} ms")
+        finally:
+            M.pallas_onehot_sampling_merged = real_kernel
+
+    if "sel_ablate" in parts:
+        # in-model top-k cost: replace the 8400->300 top_k with a static slice
+        import dataclasses
+
+        real_topk = jax.lax.top_k
+
+        def fake_topk(x, k):
+            return (
+                x[..., :k],
+                jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (*x.shape[:-1], k)),
+            )
+
+        jax.lax.top_k = fake_topk
+        try:
+            mod = RTDetrDetector(cfg, dtype=dt, backbone_dtype=bdt)
+            params = mod.init(jax.random.PRNGKey(0), px[:1])["params"]
+            f = jax.jit(lambda p, x, m=mod: m.apply({"params": p}, x)["pred_boxes"])
+            ms = timeit(f, params, px)
+            print(f"full NO-TOPK (slice select): {ms:.2f} ms")
+        finally:
+            jax.lax.top_k = real_topk
+
+    if "backbone" in parts:
+        bb = ResNetBackbone(cfg.backbone, dtype=bdt)
+        params = bb.init(jax.random.PRNGKey(0), px[:1])["params"]
+        f = jax.jit(
+            lambda p, x: sum(
+                jnp.sum(t.astype(jnp.float32)) for t in bb.apply({"params": p}, x)
+            )
+        )
+        print(f"backbone {bdt.__name__}: {timeit(f, params, px):.2f} ms")
+
+    if "topk" in parts:
+        s = 80 * 80 + 40 * 40 + 20 * 20
+        scores = jnp.asarray(
+            np.random.default_rng(1).standard_normal((b, s, 80)), jnp.float32
+        )
+
+        def sel(sc):
+            _, ind = jax.lax.top_k(sc.max(-1), cfg.num_queries)
+            return ind
+
+        print(f"top_k(8400->300) incl. class-max: {timeit(jax.jit(sel), scores):.2f} ms")
+
+        def sel_approx(sc):
+            _, ind = jax.lax.approx_max_k(sc.max(-1), cfg.num_queries)
+            return ind
+
+        print(f"approx_max_k(8400->300): {timeit(jax.jit(sel_approx), scores):.2f} ms")
+
+    if "msda" in parts:
+        from spotter_tpu.ops import msda as M
+
+        heads, hd, q_n, pts = 8, 32, 300, 4
+        shapes = ((80, 80), (40, 40), (20, 20))
+        s = sum(hh * ww for hh, ww in shapes)
+        rng = np.random.default_rng(0)
+        value = jnp.asarray(rng.standard_normal((b, s, heads, hd)), dt)
+        loc = jnp.asarray(rng.random((b, q_n, heads, len(shapes) * pts, 2)), dt)
+        attn = jax.nn.softmax(
+            jnp.asarray(rng.standard_normal((b, q_n, heads, len(shapes) * pts)), dt)
+        )
+        f = jax.jit(
+            lambda v, l, a: M.deformable_sampling(v, l, a, shapes, pts, backend="pallas")
+        )
+        ms = timeit(f, value, loc, attn)
+        print(
+            f"msda pallas x1 ({args.dtype}, prec={M.MSDA_MXU_PRECISION}): {ms:.2f} ms "
+            f"(x6 = {6 * ms:.1f} ms)"
+        )
+        # the same op twice in one jit: does the second call cost the full
+        # launch again (launch-bound) or less (pipelined)?
+        f2 = jax.jit(
+            lambda v, l, a: (
+                M.deformable_sampling(v, l, a, shapes, pts, backend="pallas"),
+                M.deformable_sampling(v * 1.0001, l, a, shapes, pts, backend="pallas"),
+            )
+        )
+        ms2 = timeit(f2, value, loc, attn)
+        print(f"msda pallas x2 independent in one jit: {ms2:.2f} ms")
+
+    if "launch" in parts:
+        # trivial pallas kernel: measures fixed pallas_call launch overhead
+        from jax.experimental import pallas as pl
+
+        def _k(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        x = jnp.ones((8, 128), jnp.float32)
+        probe = pl.pallas_call(
+            _k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32)
+        )
+        f1 = jax.jit(lambda v: probe(v))
+        f4 = jax.jit(lambda v: probe(probe(probe(probe(v)))))
+        a, c = timeit(f1, x, iters=50), timeit(f4, x, iters=50)
+        print(f"pallas launch probe: x1 {a:.3f} ms, x4 {c:.3f} ms -> per-call ~{(c - a) / 3:.3f} ms")
+
+
+if __name__ == "__main__":
+    main()
