@@ -498,7 +498,7 @@ class DeformableDetrDetector(nn.Module):
         enc_coord_logits = delta + output_proposals
 
         k = cfg.two_stage_num_proposals
-        # radix-bisect top-k (ops/topk.py): lax.top_k result, no S-wide sort
+        # ops/topk.py: lax.top_k by default, SPOTTER_TPU_TOPK=bisect opt-in
         _, topk_ind = fast_top_k(enc_class[..., 0].astype(jnp.float32), k)
         topk_coords_logits = jnp.take_along_axis(
             enc_coord_logits, topk_ind[..., None], axis=1

@@ -381,8 +381,8 @@ class RTDetrDetector(nn.Module):
             + anchors
         )
 
-        # radix-bisect top-k (ops/topk.py): same result as lax.top_k without
-        # the S-wide sort (measured ~3.3 ms of the batch-8 forward on v5e)
+        # ops/topk.py: lax.top_k by default; SPOTTER_TPU_TOPK=bisect swaps in
+        # the sort-free radix path (identical result, for wider-S hardware)
         _, topk_ind = fast_top_k(enc_class.max(-1), cfg.num_queries)
         gather = lambda arr: jnp.take_along_axis(arr, topk_ind[..., None], axis=1)
         reference_logits = gather(enc_coord_logits)

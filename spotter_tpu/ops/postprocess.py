@@ -38,8 +38,7 @@ def sigmoid_topk_postprocess(
     """
     b, q, c = logits.shape
     scores = jax.nn.sigmoid(logits).reshape(b, q * c)
-    # radix-bisect selection on TPU (ops/topk.py): identical result to
-    # lax.top_k without the (B, Q*C)-wide sort
+    # ops/topk.py: lax.top_k by default, SPOTTER_TPU_TOPK=bisect opt-in
     top_scores, top_idx = fast_top_k(scores, k)
     labels = top_idx % c
     query_idx = top_idx // c

@@ -75,6 +75,21 @@ def test_pallas_interpret_matches_xla(backend):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.parametrize("backend", ["pallas", "pallas_sep"])
+def test_sort_disabled_matches_xla(backend, monkeypatch):
+    """SPOTTER_TPU_MSDA_SORT=0 (identity permutation, no q-row permutes) is a
+    pure performance knob: results must match the sorted path bit-for-policy."""
+    import spotter_tpu.ops.msda as M
+
+    monkeypatch.setattr(M, "MSDA_SORT", False)
+    value, loc, attn = _random_inputs(4)
+    got = deformable_sampling(
+        value, loc, attn, SHAPES, P, backend=backend, interpret=True
+    )
+    ref = deformable_sampling(value, loc, attn, SHAPES, P, backend="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
 def test_discrete_method_parity():
     """Discrete (nearest, border-clamped) path: XLA vs original formulation."""
     value, loc, attn = _random_inputs(2)

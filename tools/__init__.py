@@ -1,0 +1,2 @@
+# makes tools/ importable as a package (the scripts also insert the repo
+# root on sys.path so `python tools/<script>.py` resolves `tools.timing`)

@@ -1,0 +1,89 @@
+"""Loop-in-jit timing of top-k variants at the R101 selection shapes.
+
+Uses tools/timing.timeit_loop (per-dispatch tunnel overhead is ms-scale and
+session-dependent — see that module). Splits the radix-bisect path into its
+two halves (threshold search vs compaction) to show where it spends.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--shape", default="8,8400")
+    parser.add_argument("--k", type=int, default=300)
+    parser.add_argument("--loop", type=int, default=50)
+    parser.add_argument("--iters", type=int, default=5)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from spotter_tpu.ops import topk as T
+
+    b, s = (int(v) for v in args.shape.split(","))
+    k = args.k
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((b, s)), jnp.float32
+    )
+
+    from tools.timing import timeit_loop as _timeit
+
+    def timeit_loop(step):
+        return _timeit(step, x, loop=args.loop, iters=args.iters)
+
+    def lax_step(v):
+        vals, idx = jax.lax.top_k(v, k)
+        return vals.sum() + idx.sum().astype(jnp.float32)
+
+    def bisect_step(v):
+        vals, idx = T.bisect_top_k(v, k)
+        return vals.sum() + idx.sum().astype(jnp.float32)
+
+    def threshold_step(v):
+        key = T._ordered_key(v)
+
+        def body(i, t):
+            cand = t | (jnp.uint32(1) << (31 - i))
+            cnt = (key >= cand[:, None]).sum(axis=1)
+            return jnp.where(cnt >= k, cand, t)
+
+        kth = jax.lax.fori_loop(0, 32, body, jnp.zeros((b,), jnp.uint32))
+        return kth.sum().astype(jnp.float32)
+
+    def compact_step(v):
+        # fixed fake threshold: isolates mask+cumsum+scatter+small-sort cost
+        key = T._ordered_key(v)
+        kth = jnp.full((b,), jnp.uint32(0x80000000))
+        gt = key > kth[:, None]
+        eq = key == kth[:, None]
+        need = k - gt.sum(axis=1, keepdims=True)
+        sel = gt | (eq & (jnp.cumsum(eq, axis=1) <= need))
+        rank = jnp.cumsum(sel, axis=1)
+        pos = jnp.where(sel, rank - 1, k)
+        bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, s))
+        sidx = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        idx_by_index = (
+            jnp.zeros((b, k + 1), jnp.int32).at[bidx, pos].set(sidx, mode="drop")[:, :k]
+        )
+        vals = jnp.take_along_axis(v, idx_by_index, axis=1)
+        vals_sorted, order = jax.lax.top_k(vals, k)
+        return vals_sorted.sum() + order.sum().astype(jnp.float32)
+
+    for name, step in (
+        ("lax.top_k", lax_step),
+        ("bisect_top_k", bisect_step),
+        ("  threshold half", threshold_step),
+        ("  compaction half", compact_step),
+    ):
+        print(f"{name:18s}: {timeit_loop(step):.3f} ms/iter")
+
+
+if __name__ == "__main__":
+    main()

@@ -160,6 +160,88 @@ def main():
         )
         print(f"backbone {bdt.__name__}: {timeit(f, params, px):.2f} ms")
 
+    if "stem2" in parts:
+        # per-conv stem breakdown + lowering prototypes, LOOP-IN-JIT
+        # (tools/timing.py: the per-dispatch tunnel floor is ms-scale, so
+        # sub-10 ms ops are meaningless under chained-dispatch timing —
+        # the superseded "stem" part measured a bare maxpool at 7 ms).
+        from flax import linen as nn
+
+        from tools.timing import timeit_loop
+
+        rng0 = jax.random.PRNGKey(0)
+        x640 = jnp.asarray(
+            np.random.default_rng(0).standard_normal((b, 640, 640, 3)), bdt
+        )
+        x320_32 = jnp.asarray(
+            np.random.default_rng(0).standard_normal((b, 320, 320, 32)), bdt
+        )
+        x320_64 = jnp.asarray(
+            np.random.default_rng(0).standard_normal((b, 320, 320, 64)), bdt
+        )
+
+        def conv_step(feat, k, s, x):
+            m = nn.Conv(feat, (k, k), strides=(s, s), padding=k // 2, dtype=bdt)
+            p = m.init(rng0, x[:1])["params"]
+            return lambda v: jnp.sum(m.apply({"params": p}, v).astype(jnp.float32))
+
+        print(f"stem conv0 3x3s2 3->32 @640: {timeit_loop(conv_step(32, 3, 2, x640), x640):.2f} ms")
+        print(f"stem conv1 3x3s1 32->32 @320: {timeit_loop(conv_step(32, 3, 1, x320_32), x320_32):.2f} ms")
+        print(f"stem conv2 3x3s1 32->64 @320: {timeit_loop(conv_step(64, 3, 1, x320_32), x320_32):.2f} ms")
+
+        pool_step = lambda v: jnp.sum(
+            nn.max_pool(v, (3, 3), (2, 2), padding=((1, 1), (1, 1))).astype(jnp.float32)
+        )
+        print(f"stem maxpool 3x3s2 @320x64: {timeit_loop(pool_step, x320_64):.2f} ms")
+
+        # whole stem (3 ConvNorms + pool) and whole backbone in one loop each
+        from spotter_tpu.models.layers import ConvNorm
+
+        class StemOnly(nn.Module):
+            dtype: jnp.dtype = jnp.float32
+
+            @nn.compact
+            def __call__(self, x):
+                e = cfg.backbone.embedding_size
+                x = ConvNorm(e // 2, 3, 2, activation="relu", dtype=self.dtype, name="stem0")(x)
+                x = ConvNorm(e // 2, 3, 1, activation="relu", dtype=self.dtype, name="stem1")(x)
+                x = ConvNorm(e, 3, 1, activation="relu", dtype=self.dtype, name="stem2")(x)
+                return nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
+
+        stem = StemOnly(dtype=bdt)
+        sp = stem.init(rng0, x640[:1])["params"]
+        print(
+            f"stem total (loop): "
+            f"{timeit_loop(lambda v: jnp.sum(stem.apply({'params': sp}, v).astype(jnp.float32)), x640):.2f} ms"
+        )
+
+        bb = ResNetBackbone(cfg.backbone, dtype=bdt)
+        bp = bb.init(rng0, x640[:1])["params"]
+        print(
+            f"backbone total (loop): "
+            f"{timeit_loop(lambda v: sum(jnp.sum(t.astype(jnp.float32)) for t in bb.apply({'params': bp}, v)), x640):.2f} ms"
+        )
+
+        # prototype: conv1 as 9-shift im2col + one MXU matmul
+        w288 = jnp.asarray(
+            np.random.default_rng(1).standard_normal((288, 32)) * 0.05, bdt
+        )
+
+        def im2col_step(v):
+            pads = jnp.pad(v, ((0, 0), (1, 1), (1, 1), (0, 0)))
+            cols = jnp.concatenate(
+                [
+                    pads[:, di : di + 320, dj : dj + 320, :]
+                    for di in range(3)
+                    for dj in range(3)
+                ],
+                axis=-1,
+            )
+            y = cols.reshape(b, -1, 288) @ w288
+            return jnp.sum(y.astype(jnp.float32))
+
+        print(f"proto conv1 im2col+matmul: {timeit_loop(im2col_step, x320_32):.2f} ms")
+
     if "topk" in parts:
         s = 80 * 80 + 40 * 40 + 20 * 20
         scores = jnp.asarray(
