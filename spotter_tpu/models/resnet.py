@@ -8,13 +8,79 @@ strided 1x1 projection shortcuts — the backbone of facebook/detr-resnet-*.
 NHWC layout, frozen BN.
 """
 
+import os
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 from spotter_tpu.models.configs import ResNetConfig
-from spotter_tpu.models.layers import ConvNorm, get_activation
+from spotter_tpu.models.layers import ConvNorm, FrozenBatchNorm, get_activation
+
+# Space-to-depth first stem conv (process-start knob, default off until the
+# measured win is recorded in BASELINE.md): the deep stem's 3x3 stride-2
+# conv on (H, W, 3) runs at a few percent of MXU peak on v5e (3 input
+# channels). With SPOTTER_TPU_S2D_STEM=1 the same conv executes as
+# space-to-depth(2) + a 2x2 stride-1 conv over 12 channels — an EXACT
+# weight rearrangement of the checkpoint's (3, 3, 3, C) kernel done at
+# trace time, so the param tree, converter, and numerics (up to float
+# reassociation) are unchanged. Requires even H and W (every serving
+# bucket; odd inputs fall back to the plain conv).
+S2D_STEM = os.environ.get("SPOTTER_TPU_S2D_STEM", "0") != "0"
+
+
+class _KernelHolder(nn.Module):
+    """Declares `kernel` at the exact param path/shape nn.Conv would, so the
+    s2d stem stays checkpoint-compatible with the ConvNorm it replaces."""
+
+    shape: tuple
+
+    @nn.compact
+    def __call__(self) -> jnp.ndarray:
+        return self.param(
+            "kernel", nn.initializers.lecun_normal(), self.shape, jnp.float32
+        )
+
+
+class DeepStemS2DConv(nn.Module):
+    """stem0 (ConvNorm 3x3 s2 pad 1) as space-to-depth + 2x2 s1 conv.
+
+    Derivation: out(i,j) = sum_{d in {0,1,2}^2} x[2i+di-1, 2j+dj-1] w[di,dj].
+    Packing 2x2 input blocks as channels (a = row-in-block, b = col), the
+    receptive rows {2i-1, 2i, 2i+1} live in blocks {i-1, i}: kernel index
+    ki = (di+1)//2, in-block row a = (di+1)%2 (slot (ki=0, a=0) = row 2i-2
+    is never read -> zero weight), with one zero block padded in front —
+    identical zeros to the plain conv's pad-by-1.
+    """
+
+    features: int
+    activation: Optional[str] = None
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, h, w, c = x.shape
+        kern = _KernelHolder((3, 3, c, self.features), name="conv")()
+        w2 = jnp.zeros((2, 2, 4 * c, self.features), kern.dtype)
+        for di in range(3):
+            ki, a = (di + 1) // 2, (di + 1) % 2
+            for dj in range(3):
+                kj, bb = (dj + 1) // 2, (dj + 1) % 2
+                lo = a * 2 * c + bb * c
+                w2 = w2.at[ki, kj, lo : lo + c].set(kern[di, dj])
+        blocks = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        blocks = blocks.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+        y = jax.lax.conv_general_dilated(
+            blocks.astype(self.dtype),
+            w2.astype(self.dtype),
+            window_strides=(1, 1),
+            padding=((1, 0), (1, 0)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = FrozenBatchNorm(self.features, eps=self.eps, dtype=self.dtype, name="bn")(y)
+        return get_activation(self.activation)(y)
 
 
 def avg_pool_2x2_ceil(x: jnp.ndarray) -> jnp.ndarray:
@@ -129,6 +195,13 @@ class ResNetBackbone(nn.Module):
         if cfg.style == "v1":
             # Classic stem: single 7x7 s2 conv, then 3x3 s2 max pool.
             x = ConvNorm(cfg.embedding_size, 7, 2, activation=act, dtype=self.dtype, name="stem0")(x)
+        elif S2D_STEM and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+            # Deep stem, first conv via space-to-depth (exact rearrangement).
+            x = DeepStemS2DConv(
+                cfg.embedding_size // 2, activation=act, dtype=self.dtype, name="stem0"
+            )(x)
+            x = ConvNorm(cfg.embedding_size // 2, 3, 1, activation=act, dtype=self.dtype, name="stem1")(x)
+            x = ConvNorm(cfg.embedding_size, 3, 1, activation=act, dtype=self.dtype, name="stem2")(x)
         else:
             # Deep stem: 3x3 s2 -> 3x3 -> 3x3.
             x = ConvNorm(cfg.embedding_size // 2, 3, 2, activation=act, dtype=self.dtype, name="stem0")(x)

@@ -55,6 +55,28 @@ def test_oversize_batch_splits(engine):
     assert snap["batches_total"] >= 2
 
 
+def test_empty_detect_returns_empty(engine):
+    assert engine.detect([]) == []
+
+
+def test_pipelined_multichunk_matches_serial(engine):
+    """detect()'s depth-2 pipeline returns the same per-image results, in
+    order, as running each chunk through the serial path."""
+    images = _imgs(9)  # 3 chunks at max bucket 4 (4+4+1)
+    pipelined = engine.detect(images)
+    serial = []
+    for i in range(0, len(images), engine.batch_buckets[-1]):
+        serial.extend(engine._detect_chunk(images[i : i + engine.batch_buckets[-1]]))
+    assert len(pipelined) == len(serial) == 9
+    for p, s in zip(pipelined, serial):
+        assert [d["label"] for d in p] == [d["label"] for d in s]
+        np.testing.assert_allclose(
+            np.asarray([d["box"] for d in p], np.float32),
+            np.asarray([d["box"] for d in s], np.float32),
+            atol=1e-5,
+        )
+
+
 def test_tiny_registry_model_name_matching():
     built = build_detector("PekingU/rtdetr_v2_r18vd")
     assert built.postprocess == "sigmoid_topk"

@@ -1,0 +1,114 @@
+"""OWLv2/OWL-ViT part profile: why does owlv2_base measure ~46 img/s when
+its ~1.1 TFLOP/image predicts ~140 on this chip?
+
+Loop-in-jit parts (tools/timing.py): full detect forward, vision tower
+alone, one transformer layer (flash vs naive vs no-attention), and the
+three heads over patch features. Run on the real chip.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="owlv2_base", choices=["owlv2_base", "owlvit_base"])
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--loop", type=int, default=10)
+    args = parser.parse_args()
+
+    os.environ["SPOTTER_TPU_DTYPE"] = args.dtype
+
+    import jax
+    import jax.numpy as jnp
+
+    from spotter_tpu.models.configs import OwlViTConfig, OwlViTVisionConfig
+    from spotter_tpu.models.owlvit import (
+        OwlViTClassHead,
+        OwlViTBoxHead,
+        OwlViTDetector,
+        OwlViTLayer,
+        OwlViTVisionTower,
+    )
+    from spotter_tpu.utils.precision import backbone_dtype, compute_dtype
+    from tools.timing import timeit_loop
+
+    if args.model == "owlv2_base":
+        cfg = OwlViTConfig(
+            vision=OwlViTVisionConfig(image_size=960, patch_size=16), objectness=True
+        )
+    else:
+        cfg = OwlViTConfig()
+    b = args.batch
+    h = w = cfg.vision.image_size
+    dt, vdt = compute_dtype(args.dtype), backbone_dtype(args.dtype)
+    rng = np.random.default_rng(0)
+    px = jnp.asarray(rng.standard_normal((b, h, w, 3)), jnp.float32)
+    n_tok = (h // cfg.vision.patch_size) ** 2
+    d = cfg.vision.hidden_size
+
+    # full detect forward
+    module = OwlViTDetector(cfg, dtype=dt, vision_dtype=vdt)
+    q = rng.standard_normal((22, cfg.projection_dim)).astype(np.float32)
+    q = jnp.asarray(q / np.linalg.norm(q, axis=-1, keepdims=True))
+    params = module.init(jax.random.PRNGKey(0), px[:1], q)["params"]
+
+    def full_step(v):
+        out = module.apply({"params": params}, v, q)
+        acc = out["logits"].sum() + out["pred_boxes"].sum()
+        if "objectness" in out:
+            acc = acc + out["objectness"].sum()
+        return acc
+
+    print(f"full detect ({args.model}, {args.dtype}, b{b}): "
+          f"{timeit_loop(full_step, px, loop=args.loop):.2f} ms")
+
+    # vision tower alone
+    tower = OwlViTVisionTower(cfg.vision, dtype=vdt)
+    tparams = tower.init(jax.random.PRNGKey(0), px[:1])["params"]
+    print(f"vision tower alone: "
+          f"{timeit_loop(lambda v: jnp.sum(tower.apply({'params': tparams}, v).astype(jnp.float32)), px, loop=args.loop):.2f} ms")
+
+    # one transformer layer at tower shapes (flash fires at >=1024 tokens)
+    x_tok = jnp.asarray(rng.standard_normal((b, n_tok + 1, d)), vdt)
+    layer = OwlViTLayer(
+        d, cfg.vision.num_attention_heads, cfg.vision.intermediate_size,
+        cfg.vision.hidden_act, cfg.vision.layer_norm_eps, dtype=vdt,
+    )
+    lparams = layer.init(jax.random.PRNGKey(0), x_tok[:1])["params"]
+    ms_layer = timeit_loop(
+        lambda v: jnp.sum(layer.apply({"params": lparams}, v).astype(jnp.float32)),
+        x_tok, loop=args.loop,
+    )
+    from spotter_tpu.models.layers import FLASH_ATTN_MIN_SEQ, flash_attention_enabled
+
+    attn_path = (
+        "flash"
+        if flash_attention_enabled() and (n_tok + 1) >= FLASH_ATTN_MIN_SEQ
+        else "naive"
+    )
+    print(f"one layer ({n_tok + 1} tokens, {attn_path}): {ms_layer:.2f} ms "
+          f"(x{cfg.vision.num_hidden_layers} = {ms_layer * cfg.vision.num_hidden_layers:.1f} ms)")
+
+    # heads over patch features
+    feats = jnp.asarray(rng.standard_normal((b, n_tok, d)), dt)
+    chead = OwlViTClassHead(cfg, dtype=dt)
+    cparams = chead.init(jax.random.PRNGKey(0), feats[:1], q, None)["params"]
+    print(f"class head: "
+          f"{timeit_loop(lambda v: jnp.sum(chead.apply({'params': cparams}, v, q, None).astype(jnp.float32)), feats, loop=args.loop):.2f} ms")
+
+    bhead = OwlViTBoxHead(cfg.vision, dtype=dt)
+    gh = gw = h // cfg.vision.patch_size
+    bparams = bhead.init(jax.random.PRNGKey(0), feats[:1], (gh, gw))["params"]
+    print(f"box head: "
+          f"{timeit_loop(lambda v: jnp.sum(bhead.apply({'params': bparams}, v, (gh, gw)).astype(jnp.float32)), feats, loop=args.loop):.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -242,6 +242,24 @@ def main():
 
         print(f"proto conv1 im2col+matmul: {timeit_loop(im2col_step, x320_32):.2f} ms")
 
+        # prototype: conv0 via space-to-depth (2x2 blocks -> 12 ch, 2x2 s1
+        # conv at 320^2) — exact-weight-transformable if it wins
+        w2 = jnp.asarray(
+            np.random.default_rng(2).standard_normal((2, 2, 12, 32)) * 0.05, bdt
+        )
+
+        def s2d_step(v):
+            vpad = jnp.pad(v, ((0, 0), (1, 1), (1, 1), (0, 0)))[:, :640, :640, :]
+            blocks = vpad.reshape(b, 320, 2, 320, 2, 3).transpose(0, 1, 3, 2, 4, 5)
+            blocks = blocks.reshape(b, 320, 320, 12)
+            y = jax.lax.conv_general_dilated(
+                blocks, w2, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            return jnp.sum(y.astype(jnp.float32))
+
+        print(f"proto conv0 s2d+2x2conv: {timeit_loop(s2d_step, x640):.2f} ms")
+
     if "topk" in parts:
         s = 80 * 80 + 40 * 40 + 20 * 20
         scores = jnp.asarray(
