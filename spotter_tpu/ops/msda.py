@@ -294,6 +294,13 @@ pallas_deformable_sampling.defvjp(_msda_fwd, _msda_bwd)
 # hardware tile sweeps; values are baked into compiled programs.
 S_TILE = int(os.environ.get("SPOTTER_TPU_MSDA_STILE", "640"))
 
+# Optional finer tile for the FIRST (stride-8, densest) level only: its
+# 80x80 span holds ~76% of positions, so a hit there compares a whole
+# S_TILE (8 rows at 640) even when the query tile's samples span fewer
+# rows. 0 = use S_TILE (default; the round-3 uniform sweep showed smaller
+# GLOBAL tiles lose — this knob changes level 0 alone).
+S_TILE0 = int(os.environ.get("SPOTTER_TPU_MSDA_STILE0", "0"))
+
 # Locality sort ON by default: sorting queries by quantized mean sample
 # position makes the block-sparse hit table prune (neighbor queries share
 # source bands). SPOTTER_TPU_MSDA_SORT=0 uses the identity permutation —
@@ -679,7 +686,7 @@ def _sep_level_dispatch(
 
 def _onehot_merged_kernel(
     mask_ref, idx_ref, w_ref, v_ref, out_ref,
-    *, s_tile: int, level_spans: tuple, precision,
+    *, level_tiles: tuple, precision,
 ):
     # Grid is (bh, n_qt) ONLY: the s-walk over every level's tiles is a
     # static Python unroll over slices of the fully-fetched value block.
@@ -688,24 +695,26 @@ def _onehot_merged_kernel(
     # ~3 ms/layer on machinery alone at R101 decoder shapes (4480 steps);
     # this layout pays it for 320. The s-loop being in-kernel also means the
     # value block is fetched once per (bh, nq), and each unrolled step knows
-    # its level STATICALLY (no index-map routing).
+    # its level (and its level's tile size) STATICALLY. `level_tiles` is a
+    # per-level (tile_size, span_count) tuple: finer tiles on the dense
+    # stride-8 level shrink each hit's compare footprint without touching
+    # the coarser levels (SPOTTER_TPU_MSDA_STILE0).
     qt, jc = idx_ref.shape[2], idx_ref.shape[3]
     i, nq = pl.program_id(0), pl.program_id(1)
 
     out_ref[0] = jnp.zeros_like(out_ref[0])
     step0 = 0
-    for lvl, span in enumerate(level_spans):
+    v_off = 0
+    for lvl, (ts, span) in enumerate(level_tiles):
         idx = idx_ref[0, lvl]
         w = w_ref[0, lvl]
         for k in range(span):
             ns = step0 + k
 
             @pl.when(mask_ref[i, nq, ns] != 0)
-            def _(ns=ns, k=k, idx=idx, w=w):
-                col = jax.lax.broadcasted_iota(jnp.int32, (qt, s_tile), 1) + (
-                    k * s_tile
-                )
-                oh = jnp.zeros((qt, s_tile), jnp.float32)
+            def _(k=k, idx=idx, w=w, ts=ts, lo=v_off):
+                col = jax.lax.broadcasted_iota(jnp.int32, (qt, ts), 1) + (k * ts)
+                oh = jnp.zeros((qt, ts), jnp.float32)
                 for j in range(jc):
                     oh = oh + jnp.where(
                         col == idx[:, j : j + 1],
@@ -714,39 +723,44 @@ def _onehot_merged_kernel(
                     )
                 acc = jnp.dot(
                     oh,
-                    v_ref[0, ns * s_tile : (ns + 1) * s_tile].astype(jnp.float32),
+                    v_ref[0, lo + k * ts : lo + (k + 1) * ts].astype(jnp.float32),
                     preferred_element_type=jnp.float32,
                     precision=precision,
                 )
                 out_ref[0] = out_ref[0] + acc.astype(out_ref.dtype)
 
         step0 += span
+        v_off += ts * span
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def pallas_onehot_sampling_merged(
-    rows, idx, w, mask, level_spans: tuple, interpret: bool = False
+    rows, idx, w, mask, level_tiles: tuple, interpret: bool = False
 ):
     """Block-sparse one-hot sampling over ALL levels in one pallas_call.
 
-    rows: (BH, n_s_total*S_TILE, hd) — per-level spans padded to S_TILE
-    multiples and concatenated; idx/w: (BH, L, Qp, jc) level-LOCAL corner
+    rows: (BH, s_cat, hd) — per-level spans each padded to their own tile
+    multiple and concatenated; idx/w: (BH, L, Qp, jc) level-LOCAL corner
     indices/weights (invalid slots negative/zero); mask: (BH, Qp//Q_TILE,
-    n_s_total) hit table over the concatenated s-steps; level_spans: static
-    per-level s-step counts (sum = n_s_total). Returns (BH, Qp, hd) fp32.
+    n_s_total) hit table over the concatenated s-steps; level_tiles: static
+    per-level (tile_size, span_count) pairs (sum of tile*span = s_cat).
+    Returns (BH, Qp, hd) fp32.
     """
     bh, s_cat, hd = rows.shape
     _, n_levels, qp, jc = idx.shape
-    n_s = s_cat // S_TILE
+    level_tiles = tuple((int(t), int(s)) for t, s in level_tiles)
+    n_s = sum(span for _, span in level_tiles)
     n_qt = qp // Q_TILE
-    assert sum(level_spans) == n_s, (level_spans, n_s)
+    assert sum(t * s for t, s in level_tiles) == s_cat, (level_tiles, s_cat)
+    assert mask.shape[2] == n_s, (mask.shape, level_tiles)
     kernel = partial(
         _onehot_merged_kernel,
-        s_tile=S_TILE,
-        level_spans=tuple(level_spans),
+        level_tiles=level_tiles,
         precision=MSDA_MXU_PRECISION,
     )
-    flops = 2 * bh * n_s * (qp * S_TILE * hd + jc * qp * S_TILE)
+    flops = sum(
+        2 * bh * span * (qp * ts * hd + jc * qp * ts) for ts, span in level_tiles
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, n_qt),
@@ -786,30 +800,30 @@ def pallas_onehot_sampling_merged(
     )(mask, idx, w, rows)
 
 
-def _onehot_merged_ref(rows, idx, w, level_spans):
+def _onehot_merged_ref(rows, idx, w, level_tiles):
     """Dense reference for the merged kernel (identical primal -> exact VJP)."""
     bh, _, hd = rows.shape
     out = None
-    step0 = 0
-    for lvl, span in enumerate(level_spans):
-        rows_l = rows[:, step0 * S_TILE : (step0 + span) * S_TILE]
-        step0 += span
+    off = 0
+    for lvl, (ts, span) in enumerate(level_tiles):
+        rows_l = rows[:, off : off + ts * span]
+        off += ts * span
         part = _onehot_ref_math(rows_l, idx[:, lvl], w[:, lvl])
         out = part if out is None else out + part
     return out
 
 
-def _onehot_merged_fwd(rows, idx, w, mask, level_spans, interpret):
+def _onehot_merged_fwd(rows, idx, w, mask, level_tiles, interpret):
     return (
-        pallas_onehot_sampling_merged(rows, idx, w, mask, level_spans, interpret),
+        pallas_onehot_sampling_merged(rows, idx, w, mask, level_tiles, interpret),
         (rows, idx, w),
     )
 
 
-def _onehot_merged_bwd(level_spans, interpret, res, g):
+def _onehot_merged_bwd(level_tiles, interpret, res, g):
     rows, idx, w = res
     _, vjp = jax.vjp(
-        lambda r, ww: _onehot_merged_ref(r, idx, ww, level_spans), rows, w
+        lambda r, ww: _onehot_merged_ref(r, idx, ww, level_tiles), rows, w
     )
     d_rows, d_w = vjp(g)
     return d_rows, None, d_w, None
@@ -924,13 +938,18 @@ def deformable_sampling(
         n_qt = qp // Q_TILE
         # Per-level blocks, all feeding ONE merged pallas_call (launch
         # overhead per call is ~0.9 ms on v5e — one call per op, not per
-        # level): spans padded to S_TILE and concatenated, per-level
-        # idx/w stacked, hit masks concatenated along the s-step axis.
-        rows_cat, idx_levels, w_levels, masks, spans = [], [], [], [], []
+        # level): each level's span padded to its OWN tile multiple and
+        # concatenated, per-level idx/w stacked, hit masks concatenated
+        # along the s-step axis. The first (densest, stride-8) level may
+        # take a finer tile via SPOTTER_TPU_MSDA_STILE0: its rows-per-tile
+        # footprint shrinks, cutting each hit's compare cost without
+        # touching the coarser levels.
+        rows_cat, idx_levels, w_levels, masks, tiles = [], [], [], [], []
         for lvl, (lh, lw) in enumerate(spatial_shapes):
+            ts = S_TILE0 if (lvl == 0 and S_TILE0) else S_TILE
             s_l = lh * lw
             rows_l = rows_all[:, offs[lvl] : offs[lvl] + s_l]
-            s_pad = -(-s_l // S_TILE) * S_TILE
+            s_pad = -(-s_l // ts) * ts
             if s_pad != s_l:
                 rows_l = jnp.pad(rows_l, ((0, 0), (0, s_pad - s_l), (0, 0)))
             cols = [
@@ -941,8 +960,8 @@ def deformable_sampling(
             idx_l = idx_q[:, :, cols] - np.int32(offs[lvl])
             w_l = w_q[:, :, cols]
             # hit mask: which source tiles does each query tile touch?
-            n_s = s_pad // S_TILE
-            tile_of = jnp.where(w_l > 0, idx_l // S_TILE, -1)  # (BH, Qp, JCl)
+            n_s = s_pad // ts
+            tile_of = jnp.where(w_l > 0, idx_l // ts, -1)  # (BH, Qp, JCl)
             hits = tile_of[..., None] == jnp.arange(n_s, dtype=jnp.int32)
             mask = (
                 hits.reshape(b * h_axis, n_qt, Q_TILE, len(cols), n_s)
@@ -953,13 +972,13 @@ def deformable_sampling(
             idx_levels.append(idx_l)
             w_levels.append(w_l)
             masks.append(mask)
-            spans.append(n_s)
+            tiles.append((ts, n_s))
         out = pallas_onehot_sampling_merged(
             jnp.concatenate(rows_cat, axis=1),
             jnp.stack(idx_levels, axis=1),
             jnp.stack(w_levels, axis=1),
             jnp.concatenate(masks, axis=2),
-            tuple(spans),
+            tuple(tiles),
             interp,
         )
         out = out[:, :q].reshape(b, h_axis, q, hd)

@@ -75,6 +75,21 @@ def test_pallas_interpret_matches_xla(backend):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
 
 
+def test_finer_level0_tile_matches_xla(monkeypatch):
+    """SPOTTER_TPU_MSDA_STILE0: a finer tile on the densest level is a pure
+    performance knob — identical results to the uniform-tile kernel."""
+    import spotter_tpu.ops.msda as M
+
+    monkeypatch.setattr(M, "S_TILE", 32)
+    monkeypatch.setattr(M, "S_TILE0", 16)
+    value, loc, attn = _random_inputs(5)
+    got = deformable_sampling(
+        value, loc, attn, SHAPES, P, backend="pallas", interpret=True
+    )
+    ref = deformable_sampling(value, loc, attn, SHAPES, P, backend="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
 @pytest.mark.parametrize("backend", ["pallas", "pallas_sep"])
 def test_sort_disabled_matches_xla(backend, monkeypatch):
     """SPOTTER_TPU_MSDA_SORT=0 (identity permutation, no q-row permutes) is a
