@@ -178,6 +178,39 @@ def test_microbatcher_batches_concurrent_requests():
     assert engine.calls == [4] or sum(engine.calls) == 4
 
 
+def test_stop_fails_batch_held_at_slot_acquire():
+    """stop() while the pump holds a drained batch (waiting for an in-flight
+    slot) must fail that batch's futures, not strand them forever."""
+    import threading
+
+    release = threading.Event()
+
+    class BlockingEngine(FakeEngine):
+        def detect(self, images):
+            release.wait(timeout=10.0)
+            return super().detect(images)
+
+    engine = BlockingEngine([{"label": "tv", "score": 0.9, "box": [0, 0, 5, 5]}])
+    batcher = MicroBatcher(engine, max_batch=1, max_delay_ms=1.0, max_in_flight=1)
+    img = Image.fromarray(np.zeros((8, 8, 3), np.uint8))
+
+    async def run():
+        first = asyncio.create_task(batcher.submit(img))
+        await asyncio.sleep(0.1)  # first batch now blocks inside detect()
+        second = asyncio.create_task(batcher.submit(img))
+        await asyncio.sleep(0.1)  # pump drained it and waits on the slot
+        stop = asyncio.create_task(batcher.stop())
+        await asyncio.sleep(0.05)
+        release.set()  # let the in-flight batch finish so stop() completes
+        await stop
+        return first, second
+
+    first, second = asyncio.run(run())
+    assert first.result() == [{"label": "tv", "score": 0.9, "box": [0, 0, 5, 5]}]
+    with pytest.raises(RuntimeError, match="MicroBatcher stopped"):
+        second.result()
+
+
 def test_validation_error_rejects_bad_payload():
     detector, _ = _detector([])
 

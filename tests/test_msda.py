@@ -77,7 +77,8 @@ def test_pallas_interpret_matches_xla(backend):
 
 def test_finer_level0_tile_matches_xla(monkeypatch):
     """SPOTTER_TPU_MSDA_STILE0: a finer tile on the densest level is a pure
-    performance knob — identical results to the uniform-tile kernel."""
+    performance knob — identical results AND gradients (the VJP reference's
+    per-level offset arithmetic) vs the uniform-tile/xla paths."""
     import spotter_tpu.ops.msda as M
 
     monkeypatch.setattr(M, "S_TILE", 32)
@@ -88,6 +89,21 @@ def test_finer_level0_tile_matches_xla(monkeypatch):
     )
     ref = deformable_sampling(value, loc, attn, SHAPES, P, backend="xla")
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+    def loss(backend):
+        def f(v, a):
+            out = deformable_sampling(
+                v, loc, a, SHAPES, P, backend=backend,
+                interpret=backend != "xla",
+            )
+            return (out * out).sum()
+
+        return jax.grad(f, argnums=(0, 1))
+
+    g_pal = loss("pallas")(value, attn)
+    g_ref = loss("xla")(value, attn)
+    for a, b in zip(g_pal, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
 @pytest.mark.parametrize("backend", ["pallas", "pallas_sep"])
