@@ -2,9 +2,15 @@
 XLA, across batch sizes and precisions. Run on the real chip."""
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+# run as `python tools/bench_msda.py`: script dir is on sys.path, repo root
+# (the spotter_tpu package) is not
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
