@@ -107,6 +107,20 @@ def inverse_sigmoid(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
     return jnp.log(x1 / x2)
 
 
+def fold_bn(
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    mean: jnp.ndarray,
+    var: jnp.ndarray,
+    eps: float,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Frozen-BN stats folded to one (mul, add) pair — the single source of
+    the fold arithmetic, shared by FrozenBatchNorm and the fused RepVgg path
+    (models/rtdetr.py) so the two can never diverge numerically."""
+    mul = scale * jax.lax.rsqrt(var + eps)
+    return mul, bias - mean * mul
+
+
 class FrozenBatchNorm(nn.Module):
     """Inference-mode batch norm: y = (x - mean) / sqrt(var + eps) * scale + bias.
 
@@ -125,8 +139,7 @@ class FrozenBatchNorm(nn.Module):
         mean = self.param("mean", nn.initializers.zeros, (self.features,), jnp.float32)
         var = self.param("var", nn.initializers.ones, (self.features,), jnp.float32)
         # Fold into a single multiply-add (XLA fuses this into the preceding conv).
-        mul = scale * jax.lax.rsqrt(var + self.eps)
-        add = bias - mean * mul
+        mul, add = fold_bn(scale, bias, mean, var, self.eps)
         return (x * mul.astype(self.dtype) + add.astype(self.dtype)).astype(self.dtype)
 
 
@@ -159,6 +172,58 @@ class ConvNorm(nn.Module):
         )(x)
         x = FrozenBatchNorm(self.features, eps=self.eps, dtype=self.dtype, name="bn")(x)
         return get_activation(self.activation)(x)
+
+
+class ConvNormParams(nn.Module):
+    """The exact param tree of ConvNorm (conv/kernel + bn stats) WITHOUT the
+    computation, returned as a BN-folded (kernel*mul, add) pair.
+
+    Lives here, directly below the two modules whose param contract it
+    shadows (nn.Conv-in-ConvNorm and FrozenBatchNorm): any change to their
+    param names/shapes/initializers must be mirrored in the declarations
+    below, and tests/test_rep_fuse.py pins the two trees identical. Used by
+    the fused RepVgg path (models/rtdetr.py REP_FUSE).
+    """
+
+    features: int
+    kernel_size: int
+    in_features: int
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        k = self.kernel_size
+        kernel = ConvKernel((k, k, self.in_features, self.features), name="conv")()
+        mul, add = _BNStats(self.features, self.eps, name="bn")()
+        return kernel * mul, add
+
+
+class ConvKernel(nn.Module):
+    """`kernel` at the path/shape/init nn.Conv(name=...) declares it."""
+
+    shape: tuple
+
+    @nn.compact
+    def __call__(self) -> jnp.ndarray:
+        return self.param(
+            "kernel", nn.initializers.lecun_normal(), self.shape, jnp.float32
+        )
+
+
+class _BNStats(nn.Module):
+    """The four FrozenBatchNorm params at its exact paths, returned folded
+    as (mul, add)."""
+
+    features: int
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        scale = self.param("scale", nn.initializers.ones, (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+        mean = self.param("mean", nn.initializers.zeros, (self.features,), jnp.float32)
+        var = self.param("var", nn.initializers.ones, (self.features,), jnp.float32)
+        return fold_bn(scale, bias, mean, var, self.eps)
 
 
 class PReLU(nn.Module):

@@ -16,7 +16,12 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from spotter_tpu.models.configs import ResNetConfig
-from spotter_tpu.models.layers import ConvNorm, FrozenBatchNorm, get_activation
+from spotter_tpu.models.layers import (
+    ConvKernel,
+    ConvNorm,
+    FrozenBatchNorm,
+    get_activation,
+)
 
 # Space-to-depth first stem conv (process-start knob, default off until the
 # measured win is recorded in BASELINE.md): the deep stem's 3x3 stride-2
@@ -28,19 +33,6 @@ from spotter_tpu.models.layers import ConvNorm, FrozenBatchNorm, get_activation
 # reassociation) are unchanged. Requires even H and W (every serving
 # bucket; odd inputs fall back to the plain conv).
 S2D_STEM = os.environ.get("SPOTTER_TPU_S2D_STEM", "0") != "0"
-
-
-class _KernelHolder(nn.Module):
-    """Declares `kernel` at the exact param path/shape nn.Conv would, so the
-    s2d stem stays checkpoint-compatible with the ConvNorm it replaces."""
-
-    shape: tuple
-
-    @nn.compact
-    def __call__(self) -> jnp.ndarray:
-        return self.param(
-            "kernel", nn.initializers.lecun_normal(), self.shape, jnp.float32
-        )
 
 
 class DeepStemS2DConv(nn.Module):
@@ -62,7 +54,7 @@ class DeepStemS2DConv(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         b, h, w, c = x.shape
-        kern = _KernelHolder((3, 3, c, self.features), name="conv")()
+        kern = ConvKernel((3, 3, c, self.features), name="conv")()
         w2 = jnp.zeros((2, 2, 4 * c, self.features), kern.dtype)
         for di in range(3):
             ki, a = (di + 1) // 2, (di + 1) % 2

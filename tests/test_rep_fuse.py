@@ -1,0 +1,76 @@
+"""RepVGG re-parameterization (models/rtdetr.py REP_FUSE): the fused
+single-conv path must be checkpoint-compatible with (identical param tree)
+and numerically equivalent to (up to float reassociation) the unfused
+conv3x3+BN + conv1x1+BN sum it replaces.
+
+The torch reference never applies this inference identity (HF RTDetr runs
+RepVggBlock unfused — modeling_rt_detr_v2); it is a TPU-side serving
+optimization, so its correctness proof lives here rather than in the torch
+parity tier.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spotter_tpu.models import rtdetr
+from spotter_tpu.utils.precision import DTYPE_ENV
+
+
+def _perturbed_params(module, rng, x):
+    params = module.init(jax.random.PRNGKey(0), x)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = []
+    for leaf in leaves:
+        vals = rng.standard_normal(leaf.shape).astype(np.float32) * 0.5
+        if leaf.ndim == 1:  # bn stats: keep var positive, scale/bias generic
+            vals = np.abs(vals) + 0.5
+        out.append(jnp.asarray(vals, leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def test_rep_fuse_param_tree_and_values_match(monkeypatch):
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, 16, 16, 32)), jnp.float32
+    )
+    blk = rtdetr.CSPRepLayer(out_channels=48, hidden_channels=32)
+
+    monkeypatch.setattr(rtdetr, "REP_FUSE", False)
+    p_unfused = blk.init(jax.random.PRNGKey(0), x)
+    monkeypatch.setattr(rtdetr, "REP_FUSE", True)
+    p_fused = blk.init(jax.random.PRNGKey(0), x)
+
+    assert jax.tree_util.tree_structure(p_unfused) == jax.tree_util.tree_structure(
+        p_fused
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_unfused), jax.tree_util.tree_leaves(p_fused)
+    ):
+        assert a.shape == b.shape and a.dtype == b.dtype
+
+    params = _perturbed_params(blk, np.random.default_rng(1), x)
+    monkeypatch.setattr(rtdetr, "REP_FUSE", False)
+    y_unfused = blk.apply(params, x)
+    monkeypatch.setattr(rtdetr, "REP_FUSE", True)
+    y_fused = blk.apply(params, x)
+
+    scale = float(jnp.max(jnp.abs(y_unfused)))
+    np.testing.assert_allclose(
+        np.asarray(y_fused), np.asarray(y_unfused), atol=1e-5 * max(scale, 1.0)
+    )
+
+
+def test_rep_fuse_default_follows_policy(monkeypatch):
+    monkeypatch.delenv("SPOTTER_TPU_REP_FUSE", raising=False)
+    monkeypatch.setenv(DTYPE_ENV, "bfloat16")
+    assert rtdetr._rep_fuse_default() is True
+    monkeypatch.setenv(DTYPE_ENV, "float32")
+    assert rtdetr._rep_fuse_default() is False
+    # "mixed" pins the transformer half (where RepVgg lives) to exact fp32
+    monkeypatch.setenv(DTYPE_ENV, "mixed")
+    assert rtdetr._rep_fuse_default() is False
+    monkeypatch.setenv("SPOTTER_TPU_REP_FUSE", "1")
+    assert rtdetr._rep_fuse_default() is True
+    monkeypatch.setenv("SPOTTER_TPU_REP_FUSE", "0")
+    monkeypatch.setenv(DTYPE_ENV, "bfloat16")
+    assert rtdetr._rep_fuse_default() is False
