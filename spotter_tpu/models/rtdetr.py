@@ -37,7 +37,11 @@ from spotter_tpu.models.layers import (
     sincos_2d_position_embedding,
 )
 from spotter_tpu.models.resnet import ResNetBackbone
-from spotter_tpu.ops.msda import deformable_sampling
+from spotter_tpu.ops.msda import (
+    deformable_sampling,
+    locality_sort_key,
+    presort_wanted,
+)
 from spotter_tpu.ops.topk import top_k as fast_top_k
 from spotter_tpu.utils.precision import compute_dtype
 
@@ -195,6 +199,7 @@ class DeformableAttention(nn.Module):
     offset_scale: float = 0.5
     method: str = "default"
     dtype: jnp.dtype = jnp.float32
+    presorted: bool = False
 
     @nn.compact
     def __call__(
@@ -238,7 +243,8 @@ class DeformableAttention(nn.Module):
         # Shared sampling core (spotter_tpu/ops/msda.py): level-split one-hot
         # Pallas kernel on TPU, XLA row-gathers elsewhere (SPOTTER_TPU_MSDA).
         out = deformable_sampling(
-            value, loc, attn, spatial_shapes, points, method=self.method
+            value, loc, attn, spatial_shapes, points, method=self.method,
+            presorted=self.presorted,
         )
         return nn.Dense(self.d_model, dtype=self.dtype, name="output_proj")(out)
 
@@ -246,6 +252,7 @@ class DeformableAttention(nn.Module):
 class DecoderLayer(nn.Module):
     config: RTDetrConfig
     dtype: jnp.dtype = jnp.float32
+    presorted: bool = False
 
     @nn.compact
     def __call__(
@@ -274,6 +281,7 @@ class DecoderLayer(nn.Module):
             offset_scale=cfg.decoder_offset_scale,
             method=cfg.decoder_method,
             dtype=self.dtype,
+            presorted=self.presorted,
             name="encoder_attn",
         )(h, position_embeddings, encoder_hidden_states, reference_points, spatial_shapes)
         h = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="encoder_attn_layer_norm")(h + cross)
@@ -455,13 +463,31 @@ class RTDetrDetector(nn.Module):
         # (the heavy matmuls in DecoderLayer/MLPHead still run self.dtype).
         ref = nn.sigmoid(reference_logits.astype(jnp.float32))
         h = target
+        # Model-level locality presort (ops/msda.py presort_wanted): the six
+        # decoder layers share one spatial ordering of the queries, so sort
+        # ONCE here by the initial reference centers (layer sampling points
+        # cluster around them; later refinement moves boxes only slightly)
+        # instead of paying argsort + two q-row permutes inside every
+        # sampling op. Exact: queries are permutation-equivariant through
+        # full self-attention, and outputs are un-permuted below. Skipped
+        # when a self-attention mask is present (denoising training) —
+        # ordering would have to permute the mask too; the in-op sort
+        # handles that case unchanged.
+        presort = presort_wanted() and self_attention_mask is None
+        if presort:
+            perm = jnp.argsort(locality_sort_key(ref[..., :2]), axis=1)
+            inv_perm = jnp.argsort(perm, axis=1)
+            h = jnp.take_along_axis(h, perm[:, :, None], axis=1)
+            ref = jnp.take_along_axis(ref, perm[:, :, None], axis=1)
         query_pos_head = MLPHead(
             2 * cfg.d_model, cfg.d_model, 2, dtype=self.dtype, name="query_pos_head"
         )
         aux_logits, aux_boxes = [], []
         for i in range(cfg.decoder_layers):
             pos = query_pos_head(ref.astype(self.dtype))
-            h = DecoderLayer(cfg, dtype=self.dtype, name=f"decoder_layer{i}")(
+            h = DecoderLayer(
+                cfg, dtype=self.dtype, presorted=presort, name=f"decoder_layer{i}"
+            )(
                 h, pos, source_flatten, ref.astype(self.dtype), spatial_shapes,
                 self_attention_mask,
             )
@@ -471,6 +497,11 @@ class RTDetrDetector(nn.Module):
             aux_logits.append(logits_i.astype(jnp.float32))
             aux_boxes.append(new_ref)
             ref = jax.lax.stop_gradient(new_ref)
+
+        if presort:
+            unperm = lambda a: jnp.take_along_axis(a, inv_perm[:, :, None], axis=1)
+            aux_logits = [unperm(a) for a in aux_logits]
+            aux_boxes = [unperm(a) for a in aux_boxes]
 
         return {
             "logits": aux_logits[-1],

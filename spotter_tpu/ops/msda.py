@@ -78,6 +78,29 @@ MSDA_ENV = "SPOTTER_TPU_MSDA"
 LANE = 128
 
 
+def locality_sort_key(xy: jnp.ndarray) -> jnp.ndarray:
+    """(…, 2) normalized xy -> (…,) int32 quantized y-major sort key.
+
+    Shared by the in-op locality sort below and model-level presorting
+    (models/rtdetr.py): y-major matches the row-major source layout, so
+    neighboring sorted queries sample the same horizontal bands and the
+    kernels' block-sparse hit tables prune."""
+    return (
+        jnp.clip((xy[..., 1] * 64).astype(jnp.int32), 0, 63) * 64
+        + jnp.clip((xy[..., 0] * 64).astype(jnp.int32), 0, 63)
+    )
+
+
+def presort_wanted() -> bool:
+    """True when a caller that can order its queries by spatial locality
+    ONCE (e.g. the RT-DETR decoder stack, whose six layers share one
+    ordering) should do so and pass `presorted=True` per op, instead of
+    paying the sort + two q-row permutes inside every sampling op
+    (measured 3.34 -> 2.97 ms per R101 layer cell, v5e). False when the
+    active backend ignores ordering (XLA gathers) or the sort is disabled."""
+    return MSDA_SORT and msda_backend(None) in ("pallas", "pallas_sep")
+
+
 def msda_backend(override: str | None = None, batch_heads: int | None = None) -> str:
     """`batch_heads` is accepted for callers that want to specialize the
     policy by problem size; with the level-split kernel the measured answer
@@ -841,13 +864,18 @@ def deformable_sampling(
     method: str = "default",
     backend: str | None = None,
     interpret: bool | None = None,
+    presorted: bool = False,
 ) -> jnp.ndarray:
     """Full MSDA core: returns (B, Q, H*hd) aggregated values.
 
     Backends (module docstring): "pallas" = gather-free one-hot MXU kernel
     (auto on TPU), "xla" = row-gather math (auto elsewhere, VJP reference),
     "pallas_gather" = experimental lane-gather kernel. `interpret=True`
-    forces kernel interpret mode (CPU tests).
+    forces kernel interpret mode (CPU tests). `presorted=True` promises the
+    queries already arrive ordered by `locality_sort_key` (see
+    `presort_wanted`), so the kernel branches skip the in-op sort and the
+    two q-row permutes; hit tables are still built from the actual indices,
+    so a broken promise only costs sparsity, never correctness.
     """
     b, s, h_axis, hd = value.shape
     q = loc.shape[1]
@@ -865,16 +893,13 @@ def deformable_sampling(
         """Quantized mean-sample-position sort key, y-major (source tiles
         are horizontal bands of each level's row-major span). Shared by both
         kernel backends so their tiling behavior can't desynchronize.
-        (None, None) when MSDA_SORT is off — callers skip the permutes
-        entirely (the sort is a sparsity heuristic, never a correctness
-        requirement)."""
-        if not MSDA_SORT:
+        (None, None) when MSDA_SORT is off or the caller presorted —
+        callers skip the permutes entirely (the sort is a sparsity
+        heuristic, never a correctness requirement)."""
+        if presorted or not MSDA_SORT:
             return None, None
         mean_xy = loc.mean(axis=(2, 3))  # (B, Q, 2) in [0, 1]
-        key = (
-            jnp.clip((mean_xy[..., 1] * 64).astype(jnp.int32), 0, 63) * 64
-            + jnp.clip((mean_xy[..., 0] * 64).astype(jnp.int32), 0, 63)
-        )
+        key = locality_sort_key(mean_xy)
         p = jnp.argsort(key, axis=1)  # (B, Q)
         return p, jnp.argsort(p, axis=1)
 

@@ -1,0 +1,64 @@
+"""Model-level locality presort (models/rtdetr.py): sorting the decoder's
+queries once by initial reference centers and running all layers
+`presorted=True` must be output-IDENTICAL to the unsorted model — it is a
+pure permutation through permutation-equivariant layers, un-permuted at the
+output. The kernel-side effect (skipping the in-op sort) is a sparsity
+heuristic only; correctness is pinned here on the XLA backend, where the
+permutation plumbing is the entire behavior change.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spotter_tpu.models import rtdetr
+from spotter_tpu.models.zoo import tiny_rtdetr_config
+
+
+def test_presort_outputs_identical(monkeypatch):
+    cfg = tiny_rtdetr_config(num_labels=7)
+    model = rtdetr.RTDetrDetector(cfg)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (2, 64, 64, 3)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    monkeypatch.setattr(rtdetr, "presort_wanted", lambda: False)
+    base = model.apply(params, x)
+    monkeypatch.setattr(rtdetr, "presort_wanted", lambda: True)
+    sorted_out = model.apply(params, x)
+
+    for k in ("logits", "pred_boxes", "aux_logits", "aux_boxes"):
+        np.testing.assert_allclose(
+            np.asarray(sorted_out[k]),
+            np.asarray(base[k]),
+            atol=2e-5,
+            rtol=1e-4,
+            err_msg=k,
+        )
+
+
+def test_presort_skipped_with_attention_mask(monkeypatch):
+    """With a denoising-style self-attention mask the model must fall back
+    to the in-op sort (the mask rows/cols are not permuted). The mask must
+    be NON-uniform — a block-diagonal denoising mask — so that a wrongly
+    applied presort (permuting queries under an un-permuted mask) would
+    change outputs and fail this test."""
+    cfg = tiny_rtdetr_config(num_labels=7)
+    model = rtdetr.RTDetrDetector(cfg)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (1, 64, 64, 3)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    # block-diagonal: first q//2 queries and the rest cannot attend across
+    q = cfg.num_queries
+    half = q // 2
+    group = (jnp.arange(q) < half).astype(jnp.int32)
+    blocked = group[:, None] != group[None, :]
+    mask = jnp.where(blocked, -jnp.inf, 0.0)[None, None, :, :]
+    monkeypatch.setattr(rtdetr, "presort_wanted", lambda: True)
+    masked = model.apply(params, x, self_attention_mask=mask)
+    monkeypatch.setattr(rtdetr, "presort_wanted", lambda: False)
+    base = model.apply(params, x, self_attention_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(masked["logits"]), np.asarray(base["logits"]), atol=2e-5, rtol=1e-4
+    )
