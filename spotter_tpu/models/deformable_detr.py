@@ -41,7 +41,11 @@ from spotter_tpu.models.layers import (
     inverse_sigmoid,
 )
 from spotter_tpu.models.resnet import ResNetBackbone
-from spotter_tpu.ops.msda import deformable_sampling
+from spotter_tpu.ops.msda import (
+    deformable_sampling,
+    locality_presort,
+    presort_wanted,
+)
 from spotter_tpu.ops.topk import top_k as fast_top_k
 
 
@@ -115,6 +119,7 @@ class MsdaAttention(nn.Module):
     num_levels: int
     num_points: int
     dtype: jnp.dtype = jnp.float32
+    presorted: bool = False
 
     @nn.compact
     def __call__(
@@ -164,7 +169,9 @@ class MsdaAttention(nn.Module):
             loc = ref_xy + offsets / points * ref_wh * 0.5
         loc = loc.reshape(b, q, heads, levels * points, 2)
 
-        out = deformable_sampling(value, loc, attn, spatial_shapes, points)
+        out = deformable_sampling(
+            value, loc, attn, spatial_shapes, points, presorted=self.presorted
+        )
         return nn.Dense(self.d_model, dtype=self.dtype, name="output_proj")(out)
 
 
@@ -184,12 +191,17 @@ class DeformableEncoderLayer(nn.Module):
         value_mask: Optional[jnp.ndarray],
     ) -> jnp.ndarray:
         cfg = self.config
+        # Encoder self-attention queries ARE the grid tokens, which arrive
+        # level-major row-major — already ordered by spatial locality — so
+        # the in-op argsort + two q-row permutes over the full token set
+        # (10k+ at 800x1333) are skipped (ops/msda.py presorted contract).
         attn_out = MsdaAttention(
             cfg.d_model,
             cfg.encoder_attention_heads,
             cfg.num_feature_levels,
             cfg.encoder_n_points,
             dtype=self.dtype,
+            presorted=True,
             name="self_attn",
         )(hidden, pos, hidden, reference_points, spatial_shapes, value_mask)
         hidden = nn.LayerNorm(
@@ -208,6 +220,7 @@ class DeformableDecoderLayer(nn.Module):
 
     config: DeformableDetrConfig
     dtype: jnp.dtype = jnp.float32
+    presorted: bool = False
 
     @nn.compact
     def __call__(
@@ -233,6 +246,7 @@ class DeformableDecoderLayer(nn.Module):
             cfg.num_feature_levels,
             cfg.decoder_n_points,
             dtype=self.dtype,
+            presorted=self.presorted,
             name="encoder_attn",
         )(hidden, query_pos, memory, reference_points, spatial_shapes, value_mask)
         hidden = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="encoder_attn_layer_norm")(
@@ -405,6 +419,15 @@ class DeformableDetrDetector(nn.Module):
             )
 
         # --- decoder: fp32 reference iteration (repo box-precision policy) ---
+        # Model-level locality presort (see models/rtdetr.py + ops/msda.py):
+        # all decoder layers share one spatial ordering of the queries, so
+        # sort once by the initial reference centers instead of per op.
+        # Exact: pure permutation through permutation-equivariant layers,
+        # un-permuted at the outputs.
+        presort = presort_wanted()
+        if presort:
+            sort_q, unsort_q = locality_presort(ref[..., :2])
+            target, query_pos, ref = sort_q(target), sort_q(query_pos), sort_q(ref)
         hq = target
         aux_logits, aux_boxes = [], []
         for i in range(cfg.decoder_layers):
@@ -414,7 +437,9 @@ class DeformableDetrDetector(nn.Module):
                 )[:, None]
             else:
                 ref_input = ref[:, :, None] * valid_ratios[:, None]
-            hq = DeformableDecoderLayer(cfg, dtype=self.dtype, name=f"decoder_layer{i}")(
+            hq = DeformableDecoderLayer(
+                cfg, dtype=self.dtype, presorted=presort, name=f"decoder_layer{i}"
+            )(
                 hq, query_pos, memory, ref_input.astype(self.dtype), spatial_shapes,
                 value_mask,
             )
@@ -438,6 +463,10 @@ class DeformableDetrDetector(nn.Module):
                 )
                 aux_boxes.append(nn.sigmoid(box_logits))
             aux_logits.append(class_head(i)(hq).astype(jnp.float32))
+
+        if presort:
+            aux_logits = [unsort_q(a) for a in aux_logits]
+            aux_boxes = [unsort_q(a) for a in aux_boxes]
 
         outputs.update(
             logits=aux_logits[-1],

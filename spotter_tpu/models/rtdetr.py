@@ -39,7 +39,7 @@ from spotter_tpu.models.layers import (
 from spotter_tpu.models.resnet import ResNetBackbone
 from spotter_tpu.ops.msda import (
     deformable_sampling,
-    locality_sort_key,
+    locality_presort,
     presort_wanted,
 )
 from spotter_tpu.ops.topk import top_k as fast_top_k
@@ -475,10 +475,8 @@ class RTDetrDetector(nn.Module):
         # handles that case unchanged.
         presort = presort_wanted() and self_attention_mask is None
         if presort:
-            perm = jnp.argsort(locality_sort_key(ref[..., :2]), axis=1)
-            inv_perm = jnp.argsort(perm, axis=1)
-            h = jnp.take_along_axis(h, perm[:, :, None], axis=1)
-            ref = jnp.take_along_axis(ref, perm[:, :, None], axis=1)
+            sort_q, unsort_q = locality_presort(ref[..., :2])
+            h, ref = sort_q(h), sort_q(ref)
         query_pos_head = MLPHead(
             2 * cfg.d_model, cfg.d_model, 2, dtype=self.dtype, name="query_pos_head"
         )
@@ -499,9 +497,8 @@ class RTDetrDetector(nn.Module):
             ref = jax.lax.stop_gradient(new_ref)
 
         if presort:
-            unperm = lambda a: jnp.take_along_axis(a, inv_perm[:, :, None], axis=1)
-            aux_logits = [unperm(a) for a in aux_logits]
-            aux_boxes = [unperm(a) for a in aux_boxes]
+            aux_logits = [unsort_q(a) for a in aux_logits]
+            aux_boxes = [unsort_q(a) for a in aux_boxes]
 
         return {
             "logits": aux_logits[-1],

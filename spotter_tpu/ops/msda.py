@@ -91,6 +91,25 @@ def locality_sort_key(xy: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def locality_presort(xy: jnp.ndarray):
+    """(B, Q, 2) normalized centers -> (sort, unsort) callables that
+    permute / un-permute (B, Q, ...) tensors along axis 1 by
+    `locality_sort_key` order. The single implementation of the model-level
+    presort contract (rtdetr.py / deformable_detr.py decoders): both
+    decoders and the kernels' tiling assumption stay in lockstep by
+    construction."""
+    perm = jnp.argsort(locality_sort_key(xy), axis=1)
+    inv_perm = jnp.argsort(perm, axis=1)
+
+    def sort(a: jnp.ndarray) -> jnp.ndarray:
+        return jnp.take_along_axis(a, perm[:, :, None], axis=1)
+
+    def unsort(a: jnp.ndarray) -> jnp.ndarray:
+        return jnp.take_along_axis(a, inv_perm[:, :, None], axis=1)
+
+    return sort, unsort
+
+
 def presort_wanted() -> bool:
     """True when a caller that can order its queries by spatial locality
     ONCE (e.g. the RT-DETR decoder stack, whose six layers share one

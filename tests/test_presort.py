@@ -11,7 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spotter_tpu.models import rtdetr
+from spotter_tpu.models import deformable_detr, rtdetr
+from spotter_tpu.models.configs import DeformableDetrConfig, ResNetConfig
 from spotter_tpu.models.zoo import tiny_rtdetr_config
 
 
@@ -26,6 +27,56 @@ def test_presort_outputs_identical(monkeypatch):
     base = model.apply(params, x)
     monkeypatch.setattr(rtdetr, "presort_wanted", lambda: True)
     sorted_out = model.apply(params, x)
+
+    for k in ("logits", "pred_boxes", "aux_logits", "aux_boxes"):
+        np.testing.assert_allclose(
+            np.asarray(sorted_out[k]),
+            np.asarray(base[k]),
+            atol=2e-5,
+            rtol=1e-4,
+            err_msg=k,
+        )
+
+
+def test_presort_outputs_identical_deformable(monkeypatch):
+    """Same exactness contract for the Deformable-DETR decoder presort
+    (models/deformable_detr.py), two-stage + box-refine variant so the
+    presorted refs flow through the full refinement path."""
+    cfg = DeformableDetrConfig(
+        backbone=ResNetConfig(
+            style="v1",
+            embedding_size=8,
+            hidden_sizes=(8, 12, 16, 24),
+            depths=(1, 1, 1, 1),
+            layer_type="basic",
+            out_indices=(2, 3, 4),
+        ),
+        num_labels=7,
+        d_model=32,
+        num_queries=12,
+        encoder_layers=1,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=48,
+        decoder_ffn_dim=48,
+        num_feature_levels=4,
+        encoder_n_points=2,
+        decoder_n_points=2,
+        with_box_refine=True,
+        two_stage=True,
+        two_stage_num_proposals=12,
+    )
+    model = deformable_detr.DeformableDetrDetector(cfg)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (2, 64, 64, 3)), jnp.float32)
+    mask = jnp.ones((2, 64, 64), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x, mask)
+
+    monkeypatch.setattr(deformable_detr, "presort_wanted", lambda: False)
+    base = model.apply(params, x, mask)
+    monkeypatch.setattr(deformable_detr, "presort_wanted", lambda: True)
+    sorted_out = model.apply(params, x, mask)
 
     for k in ("logits", "pred_boxes", "aux_logits", "aux_boxes"):
         np.testing.assert_allclose(
