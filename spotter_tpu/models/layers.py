@@ -21,10 +21,30 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-# Exact (erf) GELU to match torch's default nn.GELU / HF ACT2FN["gelu"].
+# GELU policy: torch's default nn.GELU / HF ACT2FN["gelu"] is the exact erf
+# form, which costs ~14 VPU transcendental-class ops per element — measured
+# 1.13 vs 0.08 ms against the tanh form at one yolos MLP activation
+# (8, 4300, 3072) bf16 on v5e, ~1 ms x 12 layers of pure erf. On bf16
+# tensors the tanh approximation's error (<~1e-3 absolute) sits below the
+# bf16 rounding already accepted for that tensor, so "auto" (default) uses
+# tanh there and exact erf on fp32 — the parity-pinned fp32 policy is
+# unchanged. SPOTTER_TPU_GELU=exact|tanh overrides both ways.
+_GELU_MODE = os.environ.get("SPOTTER_TPU_GELU", "auto").strip().lower()
+if _GELU_MODE not in ("auto", "exact", "tanh"):
+    raise ValueError(
+        f"SPOTTER_TPU_GELU must be auto|exact|tanh, got {_GELU_MODE!r}"
+    )
+
+
+def _gelu(x: jnp.ndarray) -> jnp.ndarray:
+    if _GELU_MODE == "tanh" or (_GELU_MODE == "auto" and x.dtype == jnp.bfloat16):
+        return nn.gelu(x, approximate=True)
+    return nn.gelu(x, approximate=False)
+
+
 ACTIVATIONS: dict[str, Callable] = {
     "relu": nn.relu,
-    "gelu": lambda x: nn.gelu(x, approximate=False),
+    "gelu": _gelu,
     "silu": nn.silu,
     "swish": nn.silu,
     "tanh": jnp.tanh,
@@ -51,6 +71,22 @@ FLASH_ATTN_MIN_SEQ = 1024
 _FLASH_ATTN_ENABLED = os.environ.get("SPOTTER_TPU_FLASH_ATTN", "1") != "0"
 _FLASH_BLOCK = 512
 
+# Which Pallas attention kernel backs the cutover. "splash" (default) is the
+# newer TPU kernel and measured faster at ViT-detector shapes — yolos-base
+# (8, 12, 4608, 64): 11.8 vs 13.9 ms/layer raw against flash_attention with
+# its best swept blocks (same session, segment ids in both). "flash" keeps
+# the original kernel. Process-start knob like the others.
+_FLASH_IMPL = os.environ.get("SPOTTER_TPU_FLASH_IMPL", "splash").strip().lower()
+if _FLASH_IMPL not in ("splash", "flash"):
+    raise ValueError(
+        f"SPOTTER_TPU_FLASH_IMPL must be splash|flash, got {_FLASH_IMPL!r}"
+    )
+# splash block sizes swept on v5e at (8, 12, 4608, 64): bq/bkv 384/2304
+# (compute 768) beat 512/512, 768/768, 1536/1536, 256/2304, */4608.
+_SPLASH_BQ = 384
+_SPLASH_BKV = 2304
+_SPLASH_BKV_COMPUTE = 768
+
 
 def flash_attention_enabled() -> bool:
     """True when the flash path may be taken on this backend (shared by
@@ -59,10 +95,13 @@ def flash_attention_enabled() -> bool:
 
 
 def flash_self_attention(q, k, v):
-    """(B, S, H, hd) pre-scaled q/k/v -> (B, S, H, hd) via the Pallas TPU
-    flash kernel. Pads S to the kernel block size; padded tokens live in a
+    """(B, S, H, hd) pre-scaled q/k/v -> (B, S, H, hd) via a Pallas TPU
+    attention kernel (splash by default, SPOTTER_TPU_FLASH_IMPL=flash for
+    the original). Pads S to the kernel block size; padded tokens live in a
     different segment id, so they can never attend to or be attended by real
     tokens (exact zeros-free equivalence with the naive path)."""
+    if _FLASH_IMPL == "splash":
+        return _splash_self_attention(q, k, v)
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes,
         SegmentIds,
@@ -97,6 +136,51 @@ def flash_self_attention(q, k, v):
         sm_scale=1.0,  # q arrives pre-scaled by head_dim**-0.5
         block_sizes=bs,
     )
+    return out[:, :, :s].transpose(0, 2, 1, 3)
+
+
+def _splash_self_attention(q, k, v):
+    """Splash-kernel backend of `flash_self_attention` (same contract:
+    (B, S, H, hd) pre-scaled inputs, padded tokens isolated by segment ids).
+
+    Block-size policy: pad S to a multiple of 768 so block_q=384 and a
+    768-multiple block_kv always divide it; block_kv prefers the swept-best
+    2304, else the largest 768-multiple divisor (1536 or 768). Splash has no
+    sm_scale — q arrives pre-scaled, matching the flash path's sm_scale=1.
+    """
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk,
+    )
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_mask as _sm,
+    )
+
+    b, s, h, hd = q.shape
+    s_pad = -(-s // 768) * 768
+    bkv = next(c for c in (_SPLASH_BKV, 1536, 768) if s_pad % c == 0)
+    bq = min(_SPLASH_BQ, s_pad)
+    bs = _sk.BlockSizes(
+        block_q=bq, block_kv=bkv, block_kv_compute=min(_SPLASH_BKV_COMPUTE, bkv),
+        block_q_dkv=bq, block_kv_dkv=bkv,
+        block_kv_dkv_compute=min(_SPLASH_BKV_COMPUTE, bkv),
+        block_q_dq=bq, block_kv_dq=bkv,
+    )
+    kernel = _sk.make_splash_mha(
+        mask=_sm.MultiHeadMask([_sm.FullMask((s_pad, s_pad))] * h),
+        head_shards=1,
+        q_seq_shards=1,
+        block_sizes=bs,
+    )
+
+    def prep(x):
+        x = x.transpose(0, 2, 1, 3)  # (B, H, S, hd)
+        if s_pad != s:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+        return x
+
+    seg = (jnp.arange(s_pad) >= s).astype(jnp.int32)
+    segs = _sk.SegmentIds(q=seg, kv=seg)
+    out = jax.vmap(kernel, in_axes=(0, 0, 0, None))(prep(q), prep(k), prep(v), segs)
     return out[:, :, :s].transpose(0, 2, 1, 3)
 
 

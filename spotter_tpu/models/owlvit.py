@@ -249,9 +249,9 @@ class OwlViTBoxHead(nn.Module):
     ) -> jnp.ndarray:
         d = self.config.hidden_size
         x = nn.Dense(d, dtype=self.dtype, name="dense0")(image_feats)
-        x = nn.gelu(x, approximate=False)
+        x = get_activation("gelu")(x)
         x = nn.Dense(d, dtype=self.dtype, name="dense1")(x)
-        x = nn.gelu(x, approximate=False)
+        x = get_activation("gelu")(x)
         x = nn.Dense(4, dtype=self.dtype, name="dense2")(x)
         bias = owlvit_box_bias(*grid_hw)  # numpy: XLA constant-folds it
         # fp32 sigmoid under bf16 compute (box precision at full-image scale)
@@ -272,9 +272,9 @@ class ObjectnessHead(nn.Module):
     def __call__(self, image_feats: jnp.ndarray) -> jnp.ndarray:
         x = jax.lax.stop_gradient(image_feats)
         x = nn.Dense(self.hidden_size, dtype=self.dtype, name="dense0")(x)
-        x = nn.gelu(x, approximate=False)
+        x = get_activation("gelu")(x)
         x = nn.Dense(self.hidden_size, dtype=self.dtype, name="dense1")(x)
-        x = nn.gelu(x, approximate=False)
+        x = get_activation("gelu")(x)
         return nn.Dense(1, dtype=self.dtype, name="dense2")(x)[..., 0]
 
 
