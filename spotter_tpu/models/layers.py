@@ -71,15 +71,18 @@ FLASH_ATTN_MIN_SEQ = 1024
 _FLASH_ATTN_ENABLED = os.environ.get("SPOTTER_TPU_FLASH_ATTN", "1") != "0"
 _FLASH_BLOCK = 512
 
-# Which Pallas attention kernel backs the cutover. "splash" (default) is the
-# newer TPU kernel and measured faster at ViT-detector shapes — yolos-base
+# Which Pallas attention kernel backs the cutover. "splash" is the newer
+# TPU kernel and measured faster at ViT-detector shapes — yolos-base
 # (8, 12, 4608, 64): 11.8 vs 13.9 ms/layer raw against flash_attention with
-# its best swept blocks (same session, segment ids in both). "flash" keeps
-# the original kernel. Process-start knob like the others.
-_FLASH_IMPL = os.environ.get("SPOTTER_TPU_FLASH_IMPL", "splash").strip().lower()
-if _FLASH_IMPL not in ("splash", "flash"):
+# its best swept blocks (same session, segment ids in both). "auto"
+# (default) follows the repo's numerics-default convention (GELU policy,
+# RepVGG fusion, MSDA precision): the faster-but-different kernel only
+# where bf16 rounding is already accepted — bf16 tensors take splash, fp32
+# keeps the established flash kernel. Process-start knob like the others.
+_FLASH_IMPL = os.environ.get("SPOTTER_TPU_FLASH_IMPL", "auto").strip().lower()
+if _FLASH_IMPL not in ("auto", "splash", "flash"):
     raise ValueError(
-        f"SPOTTER_TPU_FLASH_IMPL must be splash|flash, got {_FLASH_IMPL!r}"
+        f"SPOTTER_TPU_FLASH_IMPL must be auto|splash|flash, got {_FLASH_IMPL!r}"
     )
 # splash block sizes swept on v5e at (8, 12, 4608, 64): bq/bkv 384/2304
 # (compute 768) beat 512/512, 768/768, 1536/1536, 256/2304, */4608.
@@ -96,11 +99,14 @@ def flash_attention_enabled() -> bool:
 
 def flash_self_attention(q, k, v):
     """(B, S, H, hd) pre-scaled q/k/v -> (B, S, H, hd) via a Pallas TPU
-    attention kernel (splash by default, SPOTTER_TPU_FLASH_IMPL=flash for
-    the original). Pads S to the kernel block size; padded tokens live in a
+    attention kernel (splash on bf16 tensors / flash on fp32 under the
+    default "auto" policy — see _FLASH_IMPL). Pads S to the kernel block
+    size; padded tokens live in a
     different segment id, so they can never attend to or be attended by real
     tokens (exact zeros-free equivalence with the naive path)."""
-    if _FLASH_IMPL == "splash":
+    if _FLASH_IMPL == "splash" or (
+        _FLASH_IMPL == "auto" and q.dtype == jnp.bfloat16
+    ):
         return _splash_self_attention(q, k, v)
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes,
@@ -139,7 +145,7 @@ def flash_self_attention(q, k, v):
     return out[:, :, :s].transpose(0, 2, 1, 3)
 
 
-def _splash_self_attention(q, k, v):
+def _splash_self_attention(q, k, v, interpret: bool = False):
     """Splash-kernel backend of `flash_self_attention` (same contract:
     (B, S, H, hd) pre-scaled inputs, padded tokens isolated by segment ids).
 
@@ -170,6 +176,7 @@ def _splash_self_attention(q, k, v):
         head_shards=1,
         q_seq_shards=1,
         block_sizes=bs,
+        interpret=interpret,
     )
 
     def prep(x):

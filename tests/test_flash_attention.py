@@ -51,3 +51,24 @@ def test_flash_matches_naive_on_tpu():
     flash = np.asarray(_mha_outputs(seq, backend_force_naive=False))
     naive = np.asarray(_mha_outputs(seq, backend_force_naive=True))
     np.testing.assert_allclose(flash, naive, atol=2e-5, rtol=2e-5)
+
+
+def test_splash_interpret_matches_naive_on_cpu():
+    """The splash backend's padding / segment-id / block-size plumbing runs
+    on CPU via interpret mode (the msda-ops pattern), so a regression there
+    surfaces in CI rather than only on hardware. 1100 tokens pads to 1536:
+    a non-multiple of every block size, exercising the pad isolation."""
+    from spotter_tpu.models.layers import _splash_self_attention
+
+    rng = np.random.default_rng(0)
+    b, s, h, hd = 1, 1100, 2, 8
+    scale = hd**-0.5
+    q = jnp.asarray(rng.standard_normal((b, s, h, hd)), jnp.float32) * scale
+    k = jnp.asarray(rng.standard_normal((b, s, h, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, hd)), jnp.float32)
+
+    got = _splash_self_attention(q, k, v, interpret=True)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    weights = jax.nn.softmax(logits, axis=-1)
+    want = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)

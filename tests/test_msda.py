@@ -237,3 +237,18 @@ def test_backend_policy(monkeypatch):
     monkeypatch.setenv(MSDA_ENV, "nope")
     with pytest.raises(ValueError):
         msda_backend()
+
+
+@pytest.mark.parametrize("backend", ["pallas", "pallas_sep"])
+def test_presorted_matches_xla(backend):
+    """`presorted=True` (caller promises locality order — the decoder-level
+    presort of rtdetr/deformable_detr) must be exact for ANY input order:
+    hit tables come from the actual indices, so ordering is sparsity-only.
+    Exercised with deliberately UNSORTED queries to pin the
+    never-suppresses-a-hit property under a broken promise."""
+    value, loc, attn = _random_inputs(3)
+    got = deformable_sampling(
+        value, loc, attn, SHAPES, P, backend=backend, interpret=True, presorted=True
+    )
+    ref = deformable_sampling(value, loc, attn, SHAPES, P, backend="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
