@@ -62,7 +62,7 @@ above batch*heads ~96); dense one-hot 109.9 / 228.9; level-split 71.2 /
 formulation parity-tested against the gather reference.
 
 Backend policy: `SPOTTER_TPU_MSDA` = auto (pallas on TPU, xla elsewhere) |
-xla | pallas | pallas_gather.
+xla | pallas | pallas_sep | pallas_gather.
 """
 
 import os
