@@ -146,6 +146,40 @@ def _level_offsets(spatial_shapes: tuple[tuple[int, int], ...]) -> np.ndarray:
     return np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int32)
 
 
+def _corner_terms(xs, ys, at, w_const, h_const, method):
+    """Shared corner math of the loc-prep kernel and its jnp reference.
+
+    xs/ys/at: (..., LP) normalized sample coords + attention weights;
+    w_const/h_const: (1, LP) (or broadcastable) per-lane level dims.
+    Returns [(idx_level_local, weight)] per active corner, each (..., LP).
+    """
+    if method == "discrete":
+        cx = jnp.clip(jnp.floor(xs * w_const + 0.5), 0, w_const - 1)
+        cy = jnp.clip(jnp.floor(ys * h_const + 0.5), 0, h_const - 1)
+        idx0 = (cy * w_const + cx).astype(jnp.int32)
+        return [(idx0, at.astype(jnp.float32))]
+    gx = xs * w_const - 0.5
+    gy = ys * h_const - 0.5
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    fx = (gx - x0).astype(jnp.float32)
+    fy = (gy - y0).astype(jnp.float32)
+    out = []
+    for dy in (0, 1):
+        for dx in (0, 1):
+            xc = x0 + dx
+            yc = y0 + dy
+            valid = (xc >= 0) & (xc <= w_const - 1) & (yc >= 0) & (yc <= h_const - 1)
+            wx = fx if dx else 1.0 - fx
+            wy = fy if dy else 1.0 - fy
+            wgt = jnp.where(valid, wx * wy * at.astype(jnp.float32), 0.0)
+            idxc = (
+                jnp.clip(yc, 0, h_const - 1) * w_const + jnp.clip(xc, 0, w_const - 1)
+            ).astype(jnp.int32)
+            out.append((idxc, wgt))
+    return out
+
+
 def prepare_msda_gather(
     loc: jnp.ndarray,  # (B, H, LP, Q, 2) normalized [0,1] sample points
     attn: jnp.ndarray,  # (B, H, LP, Q) softmaxed attention weights
@@ -174,41 +208,17 @@ def prepare_msda_gather(
     lvl_w = lvl_w.reshape(shp)
     lvl_off = lvl_off.reshape(shp)
 
-    gx = loc[..., 0] * lvl_w  # pixel coords, align_corners=False
-    gy = loc[..., 1] * lvl_h
-    attn = attn.astype(jnp.float32)
-
-    if method == "discrete":
-        cx = jnp.clip(jnp.floor(gx + 0.5).astype(jnp.int32), 0, lvl_w.astype(np.int32) - 1)
-        cy = jnp.clip(jnp.floor(gy + 0.5).astype(jnp.int32), 0, lvl_h.astype(np.int32) - 1)
-        idx0 = lvl_off + cy * lvl_w.astype(np.int32) + cx
-        zeros_i = jnp.zeros_like(idx0)
-        zeros_w = jnp.zeros_like(attn)
-        idx = jnp.stack([idx0, zeros_i, zeros_i, zeros_i], axis=2)
-        w = jnp.stack([attn, zeros_w, zeros_w, zeros_w], axis=2)
-    else:
-        gx = gx - 0.5
-        gy = gy - 0.5
-        x0 = jnp.floor(gx)
-        y0 = jnp.floor(gy)
-        fx = (gx - x0).astype(jnp.float32)
-        fy = (gy - y0).astype(jnp.float32)
-
-        wi = lvl_w.astype(np.int32)
-        hi = lvl_h.astype(np.int32)
-
-        def corner(xc, yc, cw):
-            valid = (xc >= 0) & (xc <= wi - 1) & (yc >= 0) & (yc <= hi - 1)
-            xcc = jnp.clip(xc, 0, wi - 1).astype(jnp.int32)
-            ycc = jnp.clip(yc, 0, hi - 1).astype(jnp.int32)
-            return lvl_off + ycc * wi + xcc, cw * valid.astype(jnp.float32) * attn
-
-        i00, w00 = corner(x0, y0, (1 - fx) * (1 - fy))
-        i01, w01 = corner(x0 + 1, y0, fx * (1 - fy))
-        i10, w10 = corner(x0, y0 + 1, (1 - fx) * fy)
-        i11, w11 = corner(x0 + 1, y0 + 1, fx * fy)
-        idx = jnp.stack([i00, i01, i10, i11], axis=2)
-        w = jnp.stack([w00, w01, w10, w11], axis=2)
+    # Corner decomposition shared with the in-kernel prep path
+    # (_corner_terms is THE single implementation of the discrete/bilinear
+    # corner semantics); this wrapper adds the global level offsets and the
+    # fixed 4-slot corner axis the gather consumers index.
+    corners = _corner_terms(loc[..., 0], loc[..., 1], attn, lvl_w, lvl_h, method)
+    while len(corners) < 4:  # discrete: one active corner + zero slots
+        corners.append(
+            (jnp.zeros_like(corners[0][0]), jnp.zeros_like(corners[0][1]))
+        )
+    idx = jnp.stack([lvl_off + c for c, _ in corners], axis=2)
+    w = jnp.stack([cw for _, cw in corners], axis=2)
 
     # (B, H, 4, LP, Q) -> (B, H, 4, LP*Q): sample-major flat layout so the
     # kernel's group-sum is LP contiguous static slices of Q lanes.
@@ -874,6 +884,195 @@ def _onehot_merged_bwd(level_tiles, interpret, res, g):
 pallas_onehot_sampling_merged.defvjp(_onehot_merged_fwd, _onehot_merged_bwd)
 
 
+# --- in-kernel-prep variant (SPOTTER_TPU_MSDA_PREP=kernel): the corner
+# decomposition (floor, bilinear weights, validity, level-local indices)
+# moves INSIDE the kernel as ~45 VPU ops on one (Q_TILE, LP) lane group per
+# grid cell, replacing the XLA-side prep passes over (B, H, Q, 4, LP)
+# idx/w tensors (~0.3 ms/layer measured after the presort change). The hit
+# table is built outside from the y coordinates alone — exact for every
+# in-bounds corner when each level tile spans whole rows (ts % W == 0:
+# tile_of(y0*W + x0) == y0 // rows_per_tile for any x0 < W), a superset
+# otherwise only for out-of-bounds corners whose weight the kernel zeroes.
+# Default stays "xla" until the on-chip A/B records a win (BASELINE.md).
+
+MSDA_PREP = os.environ.get("SPOTTER_TPU_MSDA_PREP", "xla").strip().lower()
+if MSDA_PREP not in ("xla", "kernel"):
+    raise ValueError(f"SPOTTER_TPU_MSDA_PREP must be xla|kernel, got {MSDA_PREP!r}")
+
+
+def _onehot_merged_loc_kernel(
+    mask_ref, xy_ref, attn_ref, v_ref, out_ref,
+    *, level_tiles: tuple, level_dims: tuple, n_points: int, method: str, precision,
+):
+    qt, lp2 = xy_ref.shape[1], xy_ref.shape[2]
+    lp = lp2 // 2
+    i, nq = pl.program_id(0), pl.program_id(1)
+    out_ref[0] = jnp.zeros_like(out_ref[0])
+
+    step0 = 0
+    v_off = 0
+    for lvl, (ts, span) in enumerate(level_tiles):
+        # per-level corner build with PYTHON-scalar dims (pallas kernels may
+        # not capture trace-time array constants): ~45 VPU ops on a
+        # (Q_TILE, P) block, once per grid cell per level
+        lh, lw = level_dims[lvl]
+        sl = slice(lvl * n_points, (lvl + 1) * n_points)
+        corners = _corner_terms(
+            xy_ref[0, :, sl],
+            xy_ref[0, :, lp + lvl * n_points : lp + (lvl + 1) * n_points],
+            attn_ref[0, :, sl],
+            float(lw), float(lh), method,
+        )
+        for k in range(span):
+            ns = step0 + k
+
+            @pl.when(mask_ref[i, nq, ns] != 0)
+            def _(k=k, ts=ts, lo=v_off, corners=corners):
+                col = jax.lax.broadcasted_iota(jnp.int32, (qt, ts), 1) + (k * ts)
+                oh = jnp.zeros((qt, ts), jnp.float32)
+                for idxc, wgt in corners:
+                    for p_ in range(idxc.shape[1]):
+                        oh = oh + jnp.where(
+                            col == idxc[:, p_ : p_ + 1], wgt[:, p_ : p_ + 1], 0.0
+                        )
+                acc = jnp.dot(
+                    oh,
+                    v_ref[0, lo + k * ts : lo + (k + 1) * ts].astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                    precision=precision,
+                )
+                out_ref[0] = out_ref[0] + acc.astype(out_ref.dtype)
+
+        step0 += span
+        v_off += ts * span
+
+
+def _loc_ref(rows, xy, attn_cols, level_tiles, level_dims, n_points, method):
+    """jnp reference of the loc-prep kernel (VJP + interpret parity):
+    rows (BH, s_cat, hd), xy (BH, Qp, 2*LP), attn_cols (BH, Qp, LP) ->
+    (BH, Qp, hd) fp32."""
+    lp = attn_cols.shape[-1]
+    w_const = jnp.asarray(
+        np.repeat([float(w) for (_, w) in level_dims], n_points)[None, None, :],
+        jnp.float32,
+    )
+    h_const = jnp.asarray(
+        np.repeat([float(h) for (h, _) in level_dims], n_points)[None, None, :],
+        jnp.float32,
+    )
+    corners = _corner_terms(
+        xy[..., :lp], xy[..., lp:], attn_cols, w_const, h_const, method
+    )
+    offs_cat = np.concatenate(
+        [[0], np.cumsum([ts * span for ts, span in level_tiles])[:-1]]
+    ).astype(np.int32)
+    lane_off = jnp.asarray(
+        np.repeat(offs_cat, n_points)[None, None, :], jnp.int32
+    )
+    out = None
+    for idxc, wgt in corners:
+        g = jnp.take_along_axis(
+            rows.astype(jnp.float32),
+            (idxc + lane_off).reshape(rows.shape[0], -1, 1),
+            axis=1,
+        ).reshape(*idxc.shape, rows.shape[-1])
+        term = (g * wgt[..., None]).sum(axis=2)
+        out = term if out is None else out + term
+    return out
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def pallas_onehot_sampling_merged_loc(
+    rows, xy, attn_cols, mask,
+    level_tiles: tuple, level_dims: tuple, n_points: int, method: str,
+    interpret: bool = False,
+):
+    """Loc-prep merged kernel: corner decomposition happens in-kernel.
+
+    rows: (BH, s_cat, hd) as in `pallas_onehot_sampling_merged`; xy:
+    (BH, Qp, 2*LP) normalized sample coords, x lanes then y lanes, level-
+    major points within each half; attn_cols: (BH, Qp, LP); mask as before.
+    Padded query rows must carry zero attention (their corner weights then
+    vanish regardless of where their zero coords land).
+    """
+    bh, s_cat, hd = rows.shape
+    qp = xy.shape[1]
+    level_tiles = tuple((int(t), int(s)) for t, s in level_tiles)
+    level_dims = tuple((int(h), int(w)) for h, w in level_dims)
+    n_s = sum(span for _, span in level_tiles)
+    n_qt = qp // Q_TILE
+    lp = attn_cols.shape[-1]
+    assert sum(t * s for t, s in level_tiles) == s_cat, (level_tiles, s_cat)
+    assert mask.shape[2] == n_s, (mask.shape, level_tiles)
+    kernel = partial(
+        _onehot_merged_loc_kernel,
+        level_tiles=level_tiles,
+        level_dims=level_dims,
+        n_points=n_points,
+        method=method,
+        precision=MSDA_MXU_PRECISION,
+    )
+    jc = (1 if method == "discrete" else 4) * n_points
+    flops = sum(
+        2 * bh * span * (qp * ts * hd + jc * qp * ts) for ts, span in level_tiles
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_qt),
+        in_specs=[
+            pl.BlockSpec(
+                (1, Q_TILE, 2 * lp),
+                lambda i, nq, *_: (i, nq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, Q_TILE, lp),
+                lambda i, nq, *_: (i, nq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, s_cat, hd), lambda i, nq, *_: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, Q_TILE, hd), lambda i, nq, *_: (i, nq, 0),
+            memory_space=pltpu.VMEM,
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, qp, hd), jnp.float32),
+        grid_spec=grid_spec,
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=rows.size * 4 + xy.size * 4 + attn_cols.size * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(mask, xy, attn_cols, rows)
+
+
+def _loc_fwd(rows, xy, attn_cols, mask, level_tiles, level_dims, n_points, method, interpret):
+    out = pallas_onehot_sampling_merged_loc(
+        rows, xy, attn_cols, mask, level_tiles, level_dims, n_points, method, interpret
+    )
+    return out, (rows, xy, attn_cols)
+
+
+def _loc_bwd(level_tiles, level_dims, n_points, method, interpret, res, g):
+    rows, xy, attn_cols = res
+    _, vjp = jax.vjp(
+        lambda r, x, a: _loc_ref(r, x, a, level_tiles, level_dims, n_points, method),
+        rows, xy, attn_cols,
+    )
+    d_rows, d_xy, d_attn = vjp(g)
+    return d_rows.astype(rows.dtype), d_xy, d_attn, None
+
+
+pallas_onehot_sampling_merged_loc.defvjp(_loc_fwd, _loc_bwd)
+
+
 def deformable_sampling(
     value: jnp.ndarray,  # (B, S, H, hd)
     loc: jnp.ndarray,  # (B, Q, H, LP, 2) in [0, 1]
@@ -900,11 +1099,6 @@ def deformable_sampling(
     q = loc.shape[1]
     lp = loc.shape[3]
 
-    # (B, Q, H, LP, ...) -> (B, H, LP, Q, ...): head-major for per-(b,h) cells
-    loc_t = loc.transpose(0, 2, 3, 1, 4)
-    attn_t = attn.transpose(0, 2, 3, 1)
-    idx, w = prepare_msda_gather(loc_t, attn_t, spatial_shapes, num_points, method)
-
     chosen = msda_backend(backend, batch_heads=b * h_axis)
     interp = bool(interpret) if interpret is not None else False
 
@@ -921,6 +1115,14 @@ def deformable_sampling(
         key = locality_sort_key(mean_xy)
         p = jnp.argsort(key, axis=1)  # (B, Q)
         return p, jnp.argsort(p, axis=1)
+
+    def corner_idx_w():
+        """Lazy XLA-side corner prep — (B, H, LP, Q) head-major layout.
+        Skipped entirely by the backends that do their own decomposition
+        (pallas_sep; pallas under MSDA_PREP=kernel)."""
+        loc_t = loc.transpose(0, 2, 3, 1, 4)
+        attn_t = attn.transpose(0, 2, 3, 1)
+        return prepare_msda_gather(loc_t, attn_t, spatial_shapes, num_points, method)
 
     if chosen == "pallas_sep":
         # Separable bilinear kernel, one call per level (level-split as in
@@ -964,6 +1166,87 @@ def deformable_sampling(
         qp = -(-q // Q_TILE) * Q_TILE
         perm, inv_perm = locality_perm()
 
+        if MSDA_PREP == "kernel" and all(
+            ((S_TILE0 if (lvl == 0 and S_TILE0) else S_TILE) % lw) == 0
+            for lvl, (lh, lw) in enumerate(spatial_shapes)
+        ):
+            # In-kernel corner prep (module comment at MSDA_PREP): ship raw
+            # coords + attention; the y-only hit table is exact for every
+            # in-bounds corner because each level tile spans whole rows.
+            loc_s, attn_s = loc, attn
+            if perm is not None:
+                loc_s = jnp.take_along_axis(loc, perm[:, :, None, None, None], axis=1)
+                attn_s = jnp.take_along_axis(attn, perm[:, :, None, None], axis=1)
+            loc_bh = loc_s.transpose(0, 2, 1, 3, 4).reshape(b * h_axis, q, lp, 2)
+            xy = jnp.concatenate(
+                [loc_bh[..., 0], loc_bh[..., 1]], axis=-1
+            ).astype(jnp.float32)
+            at_bh = (
+                attn_s.transpose(0, 2, 1, 3)
+                .reshape(b * h_axis, q, lp)
+                .astype(jnp.float32)
+            )
+            if qp != q:  # padded queries: zero attention -> zero weights
+                xy = jnp.pad(xy, ((0, 0), (0, qp - q), (0, 0)))
+                at_bh = jnp.pad(at_bh, ((0, 0), (0, qp - q), (0, 0)))
+
+            rows_all = value.transpose(0, 2, 1, 3).reshape(b * h_axis, s, hd)
+            offs = _level_offsets(spatial_shapes)
+            points = num_points
+            n_qt = qp // Q_TILE
+            ys_cols = xy[:, :, lp:]
+            rows_cat, masks, tiles = [], [], []
+            for lvl, (lh, lw) in enumerate(spatial_shapes):
+                ts = S_TILE0 if (lvl == 0 and S_TILE0) else S_TILE
+                s_l = lh * lw
+                rows_l = rows_all[:, offs[lvl] : offs[lvl] + s_l]
+                s_pad = -(-s_l // ts) * ts
+                if s_pad != s_l:
+                    rows_l = jnp.pad(rows_l, ((0, 0), (0, s_pad - s_l), (0, 0)))
+                n_s = s_pad // ts
+                rpt = ts // lw  # rows per tile (whole rows by the guard)
+                y_l = ys_cols[:, :, lvl * points : (lvl + 1) * points]
+                if method == "discrete":
+                    cy = jnp.clip(
+                        jnp.floor(y_l * lh + 0.5).astype(jnp.int32), 0, lh - 1
+                    )
+                    cand = [cy // rpt]
+                else:
+                    y0 = jnp.floor(y_l * lh - 0.5).astype(jnp.int32)
+                    cand = [
+                        jnp.where((y0 >= 0) & (y0 <= lh - 1), y0 // rpt, -1),
+                        jnp.where(
+                            (y0 + 1 >= 0) & (y0 + 1 <= lh - 1), (y0 + 1) // rpt, -1
+                        ),
+                    ]
+                bands = jnp.concatenate(cand, axis=-1).reshape(
+                    b * h_axis, n_qt, -1
+                )
+                mask = (
+                    (bands[..., None] == jnp.arange(n_s, dtype=jnp.int32))
+                    .any(axis=2)
+                    .astype(jnp.int32)
+                )
+                rows_cat.append(rows_l)
+                masks.append(mask)
+                tiles.append((ts, n_s))
+            out = pallas_onehot_sampling_merged_loc(
+                jnp.concatenate(rows_cat, axis=1),
+                xy,
+                at_bh,
+                jnp.concatenate(masks, axis=2),
+                tuple(tiles),
+                tuple(spatial_shapes),
+                points,
+                method,
+                interp,
+            )
+            out = out[:, :q].reshape(b, h_axis, q, hd)
+            if inv_perm is not None:
+                out = jnp.take_along_axis(out, inv_perm[:, None, :, None], axis=2)
+            return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)
+
+        idx, w = corner_idx_w()
         idx_q = idx.reshape(b, h_axis, 4, lp, q).transpose(0, 1, 4, 2, 3)
         w_q = w.reshape(b, h_axis, 4, lp, q).transpose(0, 1, 4, 2, 3)
         if perm is not None:
@@ -1030,10 +1313,12 @@ def deformable_sampling(
             out = jnp.take_along_axis(out, inv_perm[:, None, :, None], axis=2)
         return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)
     if chosen == "pallas_gather":
+        idx, w = corner_idx_w()
         vt = value.transpose(0, 2, 3, 1)  # (B, H, hd, S): spatial on lanes
         out = pallas_deformable_sampling(vt, idx, w, lp, q, interp)
         # (B, H, hd, Q) -> (B, Q, H*hd)
         return out.transpose(0, 3, 1, 2).reshape(b, q, h_axis * hd)
+    idx, w = corner_idx_w()
     rows = value.transpose(0, 2, 1, 3)  # (B, H, S, hd): row gathers for XLA
     out = _row_gather_weighted_sum(rows, idx, w, lp, q)  # (B, H, Q, hd)
     return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)

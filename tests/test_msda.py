@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from spotter_tpu.models.layers import grid_sample_bilinear_nhwc
+import spotter_tpu.ops.msda as M
 from spotter_tpu.ops.msda import (
     MSDA_ENV,
     deformable_sampling,
@@ -252,3 +253,39 @@ def test_presorted_matches_xla(backend):
     )
     ref = deformable_sampling(value, loc, attn, SHAPES, P, backend="xla")
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("method", ["default", "discrete"])
+def test_kernel_prep_matches_xla(method, monkeypatch):
+    """SPOTTER_TPU_MSDA_PREP=kernel (in-kernel corner decomposition +
+    y-only hit table) must match the XLA gather reference exactly,
+    including out-of-bounds corners and the discrete method."""
+    monkeypatch.setattr(M, "MSDA_PREP", "kernel")
+    value, loc, attn = _random_inputs(5)
+    got = deformable_sampling(
+        value, loc, attn, SHAPES, P, method=method, backend="pallas", interpret=True
+    )
+    monkeypatch.setattr(M, "MSDA_PREP", "xla")
+    ref = deformable_sampling(value, loc, attn, SHAPES, P, method=method, backend="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_kernel_prep_gradients_match_xla(monkeypatch):
+    """The loc-prep custom VJP (backward through the jnp corner reference)
+    must agree with the XLA path's autodiff gradients for value, loc, attn."""
+    value, loc, attn = _random_inputs(6)
+
+    def loss(v, l, a, backend):
+        out = deformable_sampling(
+            v, l, a, SHAPES, P, backend=backend, interpret=(backend == "pallas")
+        )
+        return jnp.sum(out * jnp.cos(jnp.arange(out.size).reshape(out.shape)))
+
+    monkeypatch.setattr(M, "MSDA_PREP", "kernel")
+    g_kernel = jax.grad(loss, argnums=(0, 1, 2))(value, loc, attn, "pallas")
+    monkeypatch.setattr(M, "MSDA_PREP", "xla")
+    g_ref = jax.grad(loss, argnums=(0, 1, 2))(value, loc, attn, "xla")
+    for gk, gr, name in zip(g_kernel, g_ref, ("value", "loc", "attn")):
+        np.testing.assert_allclose(
+            np.asarray(gk), np.asarray(gr), atol=2e-4, err_msg=name
+        )
