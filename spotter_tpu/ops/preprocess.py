@@ -42,6 +42,11 @@ class PreprocessSpec:
     mean: tuple[float, float, float] | None = None
     std: tuple[float, float, float] | None = None
     pad_to: tuple[int, int] | None = None  # static bucket for shortest_edge mode
+    # PIL resample filter. Families differ: RT-DETR/DETR/YOLOS processors
+    # default to BILINEAR, OWL-ViT's to BICUBIC — a wrong filter shifts
+    # edge pixels by ~0.4 post-normalize and silently eats the reference's
+    # ±1 px golden tolerance (tests/test_preprocess_hf_parity.py pins each).
+    resample: int = Image.BILINEAR
 
     @property
     def input_hw(self) -> tuple[int, int]:
@@ -60,20 +65,47 @@ DETR_SPEC = PreprocessSpec(
     mode="shortest_edge", size=(800, 1333), mean=IMAGENET_MEAN, std=IMAGENET_STD,
     pad_to=(1333, 1333),
 )
-OWLVIT_SPEC = PreprocessSpec(mode="fixed", size=(768, 768), mean=CLIP_MEAN, std=CLIP_STD)
+OWLVIT_SPEC = PreprocessSpec(
+    mode="fixed", size=(768, 768), mean=CLIP_MEAN, std=CLIP_STD,
+    resample=Image.BICUBIC,
+)
 OWLV2_SPEC = PreprocessSpec(
     mode="pad_square", size=(960, 960), mean=CLIP_MEAN, std=CLIP_STD
 )
 
 
 def shortest_edge_size(hw: tuple[int, int], short: int, longest: int) -> tuple[int, int]:
-    """Output (h, w) for aspect-preserving shortest-edge resize with a long-side cap."""
+    """Output (h, w) for aspect-preserving shortest-edge resize with a long-side cap.
+
+    Mirrors the HF DETR processor's `get_size_with_aspect_ratio` arithmetic
+    exactly (int truncation, and the capped short side re-rounded before the
+    long side is derived from the UNROUNDED cap) — golden boxes depend on
+    the processor's exact output dims, and `round()` here would drift by a
+    pixel on cap-boundary aspect ratios (tests/test_preprocess_hf_parity.py).
+    """
     h, w = hw
-    lo, hi = (h, w) if h <= w else (w, h)
-    scale = short / lo
-    if hi * scale > longest:
-        scale = longest / hi
-    return max(1, round(h * scale)), max(1, round(w * scale))
+    raw_size = None
+    size = short
+    mn, mx = (h, w) if h <= w else (w, h)
+    if mx / mn * size > longest:
+        raw_size = longest * mn / mx
+        size = int(round(raw_size))
+    # HF checks the already-at-size equality case FIRST (the DETR variant;
+    # YOLOS orders its branches differently but serving warps YOLOS to a
+    # fixed size, so DETR's order is the one golden parity depends on).
+    if (h <= w and h == size) or (w <= h and w == size):
+        oh, ow = h, w
+    elif w < h:
+        ow = size
+        oh = int(raw_size * h / w) if raw_size is not None else int(size * h / w)
+    else:
+        oh = size
+        ow = int(raw_size * w / h) if raw_size is not None else int(size * w / h)
+    # Two deviations where HF's own output cannot feed a static TPU bucket:
+    # the equality branch can return original dims ONE pixel over `longest`
+    # (e.g. 666x1334 -> HF keeps 1334; clamp to the bucket), and extreme
+    # aspect ratios can truncate an edge to 0 (HF would crash in PIL too).
+    return max(1, min(oh, longest)), max(1, min(ow, longest))
 
 
 def preprocess_image(
@@ -96,7 +128,7 @@ def preprocess_image(
 
     if spec.mode == "fixed":
         th, tw = spec.size
-        resized = image.resize((tw, th), resample=Image.BILINEAR)
+        resized = image.resize((tw, th), resample=spec.resample)
         arr = rescale_normalize(np.asarray(resized, dtype=np.float32))
         mask = np.ones((th, tw), dtype=np.float32)
     elif spec.mode == "pad_square":
@@ -132,7 +164,7 @@ def preprocess_image(
         orig_hw = (side, side)
     elif spec.mode == "shortest_edge":
         rh, rw = shortest_edge_size(orig_hw, spec.size[0], spec.size[1])
-        resized = image.resize((rw, rh), resample=Image.BILINEAR)
+        resized = image.resize((rw, rh), resample=spec.resample)
         ph, pw = spec.input_hw
         # Normalize BEFORE padding: pad pixels must be exactly 0 (the torch
         # DETR processor pads after normalization; checkpoints expect 0 pads).

@@ -1,0 +1,162 @@
+"""Full-architecture torch-vs-Flax parity on the REAL RT-DETRv2-R101.
+
+The locally-executable stand-in for the reference's golden-box integration
+test (apps/spotter/tests/spotter/test_serve.py:246-326), which needs the
+real checkpoint from the network: instantiate the real R101 HF architecture
+random-init, convert through the PRODUCTION rules, and push the reference's
+own fixture image through BOTH complete pipelines —
+
+  torch:   HF image processor -> RTDetrV2ForObjectDetection ->
+           post_process_object_detection
+  spotter: preprocess_image -> InferenceEngine (bucketed jit forward +
+           fixed-k postprocess) -> to_detections
+
+— then require the same detections (labels equal, boxes within the golden
+test's own ±1 px, scores within 2e-3). This executes the real param tree
+through the converter, the real preprocess against HF's, and full-depth
+numerics; nearly all of the golden-box risk dies here without the
+checkpoint (VERDICT r3 next #2).
+
+Runtime: several minutes of single-core CPU (torch R101 forward + one
+XLA compile) — slow tier.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+torch = pytest.importorskip("torch")
+from transformers import RTDetrImageProcessor, RTDetrResNetConfig, RTDetrV2Config
+from transformers.models.rt_detr_v2.modeling_rt_detr_v2 import (
+    RTDetrV2ForObjectDetection,
+)
+
+import jax
+
+from spotter_tpu.convert.rtdetr_rules import rtdetr_rules
+from spotter_tpu.convert.torch_to_jax import convert_state_dict
+from spotter_tpu.engine.engine import BuiltDetector, InferenceEngine
+from spotter_tpu.models.coco import coco_id2label_80
+from spotter_tpu.models.configs import RTDETR_PRESETS, RTDetrConfig
+from spotter_tpu.models.rtdetr import RTDetrDetector
+from spotter_tpu.ops.preprocess import RTDETR_SPEC, preprocess_image
+
+pytestmark = pytest.mark.slow
+
+FIXTURE = "tests/test_data/test_pic.jpg"
+
+
+def _real_r101_hf_config() -> RTDetrV2Config:
+    """The published PekingU/rtdetr_v2_r101vd architecture (no network).
+
+    initializer_range is widened (as in the tiny parity tests) so random-init
+    encoder scores are distinct and torch/jax top-k select identical anchors;
+    num_denoising=0 because denoising branches exist only in training.
+    """
+    backbone = RTDetrResNetConfig(
+        embedding_size=64,
+        hidden_sizes=[256, 512, 1024, 2048],
+        depths=[3, 4, 23, 3],
+        layer_type="bottleneck",
+        out_features=["stage2", "stage3", "stage4"],
+    )
+    return RTDetrV2Config(
+        backbone_config=backbone,
+        d_model=256,
+        encoder_hidden_dim=384,
+        encoder_ffn_dim=2048,
+        encoder_in_channels=[512, 1024, 2048],
+        decoder_in_channels=[384, 384, 384],
+        decoder_layers=6,
+        num_queries=300,
+        num_labels=80,
+        num_denoising=0,
+        initializer_range=0.2,
+    )
+
+
+def test_full_r101_pipeline_parity():
+    hf_cfg = _real_r101_hf_config()
+    cfg = RTDetrConfig.from_hf(hf_cfg)
+
+    # the bench/serving preset IS this architecture (modulo label metadata)
+    preset = RTDETR_PRESETS["rtdetr_v2_r101vd"]
+    assert preset.backbone.depths == tuple(hf_cfg.backbone_config.depths)
+    assert preset.d_model == cfg.d_model
+    assert preset.encoder_hidden_dim == cfg.encoder_hidden_dim
+    assert preset.encoder_ffn_dim == cfg.encoder_ffn_dim
+    assert preset.decoder_layers == cfg.decoder_layers
+
+    torch.manual_seed(0)
+    model = RTDetrV2ForObjectDetection(hf_cfg).eval()
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, torch.nn.BatchNorm2d):
+                m.running_mean.uniform_(-0.2, 0.2)
+                m.running_var.uniform_(0.8, 1.2)
+
+    params = convert_state_dict(model.state_dict(), rtdetr_rules(cfg), strict=False)
+
+    image = Image.open(FIXTURE).convert("RGB")
+    processor = RTDetrImageProcessor()
+
+    # --- torch pipeline (the reference's serve.py flow, threshold aside)
+    inputs = processor(images=image, return_tensors="pt")
+    with torch.no_grad():
+        tout = model(**inputs)
+    t_sizes = torch.tensor([[image.height, image.width]])
+    t_all = processor.post_process_object_detection(
+        tout, threshold=0.0, target_sizes=t_sizes
+    )[0]
+    t_scores = t_all["scores"].numpy()
+    # data-derived threshold: midpoint below the ~20th score, so both sides
+    # select the same non-trivial set and a 1e-3 score wobble cannot flip
+    # membership at the boundary
+    kth = np.sort(t_scores)[::-1][20:22]
+    threshold = float(kth.mean())
+    t_res = processor.post_process_object_detection(
+        tout, threshold=threshold, target_sizes=t_sizes
+    )[0]
+    t_dets = [
+        {"label": coco_id2label_80()[int(l)], "score": float(s), "box": b.tolist()}
+        for s, l, b in zip(t_res["scores"], t_res["labels"], t_res["boxes"])
+    ]
+    assert len(t_dets) >= 5, "threshold should keep a non-trivial set"
+
+    # --- spotter pipeline: preprocess + engine + postprocess
+    # preprocess parity on the very same call the engine will make
+    arr, _, orig = preprocess_image(image, RTDETR_SPEC)
+    np.testing.assert_allclose(
+        arr, np.transpose(inputs["pixel_values"][0].numpy(), (1, 2, 0)), atol=1e-6
+    )
+    assert orig == (image.height, image.width)
+
+    jax.config.update("jax_default_matmul_precision", "highest")
+    built = BuiltDetector(
+        model_name="parity/rtdetr_v2_r101vd",
+        module=RTDetrDetector(cfg),
+        params=params,
+        preprocess_spec=RTDETR_SPEC,
+        postprocess="sigmoid_topk",
+        id2label=coco_id2label_80(),
+        num_top_queries=cfg.num_queries,
+    )
+    engine = InferenceEngine(built, threshold=threshold, batch_buckets=(1,))
+    j_dets = engine.detect([image])[0]
+
+    # --- same detections: greedy label+box matching, golden-test tolerances
+    assert len(j_dets) == len(t_dets), (j_dets, t_dets)
+    unmatched = list(range(len(j_dets)))
+    for td in t_dets:
+        best, best_d = None, np.inf
+        for i in unmatched:
+            jd = j_dets[i]
+            if jd["label"] != td["label"]:
+                continue
+            d = max(abs(a - b) for a, b in zip(jd["box"], td["box"]))
+            if d < best_d:
+                best, best_d = i, d
+        assert best is not None, f"no jax match for {td}"
+        assert best_d <= 1.0, (td, j_dets[best], best_d)
+        assert abs(j_dets[best]["score"] - td["score"]) <= 2e-3
+        unmatched.remove(best)

@@ -25,9 +25,25 @@ def test_fixed_spec_shape_and_range():
 
 
 def test_shortest_edge_size_caps_long_side():
-    assert shortest_edge_size((480, 640), 800, 1333) == (800, 1067)
+    # 1066, not round()'s 1067: the HF DETR processor truncates the derived
+    # long side (int(800*640/480)), and golden parity follows its arithmetic
+    # exactly (tests/test_preprocess_hf_parity.py).
+    assert shortest_edge_size((480, 640), 800, 1333) == (800, 1066)
     # long side would exceed the cap -> scale by the long side instead
     assert shortest_edge_size((500, 2000), 800, 1333) == (333, 1333)
+
+
+def test_shortest_edge_size_boundary_cases_fit_bucket():
+    # HF's equality branch keeps original dims even ONE pixel over the cap
+    # (666x1334 stays 1334 wide); the static bucket clamps that pixel.
+    assert shortest_edge_size((666, 1334), 800, 1333) == (666, 1333)
+    assert shortest_edge_size((1334, 666), 800, 1333) == (1333, 666)
+    # extreme aspect ratio: never emit a 0-sized edge
+    h, w = shortest_edge_size((1, 3000), 800, 1333)
+    assert h >= 1 and w >= 1 and max(h, w) <= 1333
+    # and the full preprocess must fit its static bucket on those images
+    arr, _, _ = preprocess_image(_img(666, 1334), DETR_SPEC)
+    assert arr.shape == (*DETR_SPEC.input_hw, 3)
 
 
 def test_detr_spec_landscape_and_portrait_fit_bucket():

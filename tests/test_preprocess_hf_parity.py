@@ -1,0 +1,127 @@
+"""Preprocess numerical parity against the real HF image processors.
+
+The reference's preprocessing IS `AutoImageProcessor`
+(apps/spotter/src/spotter/serve.py:98); its golden boxes depend on the
+processors' exact resample/normalize/pad behavior, and a one-pixel resize
+discrepancy would silently consume the reference's entire ±1 px golden
+tolerance (VERDICT r3 next #3). These tests instantiate the processor
+CLASSES with each checkpoint family's published defaults (no network) and
+compare `preprocess_image`'s arrays element-wise on the reference fixture
+at several aspect ratios.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+pytest.importorskip("transformers")
+from transformers import (
+    DetrImageProcessor,
+    Owlv2ImageProcessor,
+    OwlViTImageProcessor,
+    RTDetrImageProcessor,
+    YolosImageProcessor,
+)
+
+from spotter_tpu.ops.preprocess import (
+    DETR_SPEC,
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    OWLV2_SPEC,
+    OWLVIT_SPEC,
+    RTDETR_SPEC,
+    PreprocessSpec,
+    preprocess_image,
+    shortest_edge_size,
+)
+
+pytestmark = pytest.mark.slow
+
+FIXTURE = "tests/test_data/test_pic.jpg"
+
+
+def _variants():
+    """The fixture plus resized copies covering landscape/portrait/odd sizes."""
+    base = Image.open(FIXTURE).convert("RGB")
+    return [
+        base,
+        base.resize((500, 333), Image.BILINEAR),
+        base.resize((427, 640), Image.BILINEAR),  # portrait
+        base.resize((97, 131), Image.BILINEAR),  # small odd dims
+    ]
+
+
+def _hf_chw(processor, image):
+    out = processor(images=image, return_tensors="np")
+    return out, np.transpose(out["pixel_values"][0], (1, 2, 0))
+
+
+@pytest.mark.parametrize("idx", range(4))
+def test_rtdetr_matches_hf(idx):
+    img = _variants()[idx]
+    arr, mask, orig = preprocess_image(img, RTDETR_SPEC)
+    _, hf = _hf_chw(RTDetrImageProcessor(), img)
+    assert hf.shape == arr.shape
+    np.testing.assert_allclose(arr, hf, atol=1e-6)
+    assert orig == (img.height, img.width)  # target_sizes semantics
+    assert mask.all()
+
+
+@pytest.mark.parametrize("idx", range(4))
+def test_detr_shortest_edge_matches_hf(idx):
+    img = _variants()[idx]
+    arr, mask, orig = preprocess_image(img, DETR_SPEC)
+    out, hf = _hf_chw(DetrImageProcessor(), img)
+    rh, rw = hf.shape[:2]
+    # HF pads to the batch max (here: the image's own resized dims); the
+    # repo pads into the static (1333, 1333) bucket — compare the valid
+    # region and require exact zeros (and mask zeros) outside it.
+    assert (rh, rw) == shortest_edge_size((img.height, img.width), 800, 1333)
+    np.testing.assert_allclose(arr[:rh, :rw], hf, atol=1e-5)
+    assert (arr[rh:] == 0).all() and (arr[:, rw:] == 0).all()
+    assert mask[:rh, :rw].all() and not mask[rh:].any() and not mask[:, rw:].any()
+    if "pixel_mask" in out:
+        np.testing.assert_array_equal(
+            mask[:rh, :rw], out["pixel_mask"][0].astype(np.float32)
+        )
+    assert orig == (img.height, img.width)
+
+
+@pytest.mark.parametrize("idx", range(4))
+def test_yolos_fixed_warp_matches_hf_resample(idx):
+    """YOLOS serving deliberately warp-resizes to the trained static size
+    (models/zoo.py:_build_yolos — TPU static-shape policy, diverging from
+    HF's dynamic mod-16 shortest-edge + pad-to-batch-max). What must still
+    match HF is the resample/rescale/normalize pipeline itself, pinned here
+    by forcing the HF processor to the same fixed size."""
+    img = _variants()[idx]
+    size = {"height": 800, "width": 1344}
+    spec = PreprocessSpec(
+        mode="fixed", size=(800, 1344), mean=IMAGENET_MEAN, std=IMAGENET_STD
+    )
+    arr, mask, orig = preprocess_image(img, spec)
+    _, hf = _hf_chw(YolosImageProcessor(size=size, do_pad=False), img)
+    assert hf.shape == arr.shape
+    np.testing.assert_allclose(arr, hf, atol=1e-5)
+    assert orig == (img.height, img.width)
+
+
+@pytest.mark.parametrize("idx", range(4))
+def test_owlvit_matches_hf(idx):
+    img = _variants()[idx]
+    arr, mask, orig = preprocess_image(img, OWLVIT_SPEC)
+    _, hf = _hf_chw(OwlViTImageProcessor(), img)
+    assert hf.shape == arr.shape
+    np.testing.assert_allclose(arr, hf, atol=1e-5)
+    assert orig == (img.height, img.width)
+
+
+@pytest.mark.parametrize("idx", range(4))
+def test_owlv2_matches_hf(idx):
+    img = _variants()[idx]
+    arr, mask, orig = preprocess_image(img, OWLV2_SPEC)
+    _, hf = _hf_chw(Owlv2ImageProcessor(), img)
+    assert hf.shape == arr.shape
+    np.testing.assert_allclose(arr, hf, atol=2e-4)
+    side = max(img.height, img.width)
+    assert orig == (side, side)  # HF _scale_boxes uses the padded square
