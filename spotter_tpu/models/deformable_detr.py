@@ -43,6 +43,7 @@ from spotter_tpu.models.layers import (
 from spotter_tpu.models.resnet import ResNetBackbone
 from spotter_tpu.ops.msda import (
     deformable_sampling,
+    encoder_presorted,
     locality_presort,
     presort_wanted,
 )
@@ -194,14 +195,16 @@ class DeformableEncoderLayer(nn.Module):
         # Encoder self-attention queries ARE the grid tokens, which arrive
         # level-major row-major — already ordered by spatial locality — so
         # the in-op argsort + two q-row permutes over the full token set
-        # (10k+ at 800x1333) are skipped (ops/msda.py presorted contract).
+        # (10k+ at 800x1333) are skipped by default; wide-offset checkpoints
+        # can restore the in-op sort via SPOTTER_TPU_MSDA_ENC_PRESORTED=0
+        # (ops/msda.py presorted contract / encoder_presorted).
         attn_out = MsdaAttention(
             cfg.d_model,
             cfg.encoder_attention_heads,
             cfg.num_feature_levels,
             cfg.encoder_n_points,
             dtype=self.dtype,
-            presorted=True,
+            presorted=encoder_presorted(),
             name="self_attn",
         )(hidden, pos, hidden, reference_points, spatial_shapes, value_mask)
         hidden = nn.LayerNorm(

@@ -110,6 +110,19 @@ def locality_presort(xy: jnp.ndarray):
     return sort, unsort
 
 
+def encoder_presorted() -> bool:
+    """Whether MSDA *encoder* self-attention may claim its queries are
+    already locality-ordered. Encoder tokens arrive level-major row-major —
+    exactly the y-major band order the hit tables want — so the in-op
+    argsort + two q-row permutes over the full token set (10k+ at 800x1333)
+    are pure waste and default off. SPOTTER_TPU_MSDA_ENC_PRESORTED=0
+    restores the in-op mean-sample-location sort for checkpoints whose
+    encoder offsets reach far enough that sample-location order beats
+    token order (ADVICE r3: the knob must exist or such checkpoints have
+    no way back to the sorted path)."""
+    return os.environ.get("SPOTTER_TPU_MSDA_ENC_PRESORTED", "1") != "0"
+
+
 def presort_wanted() -> bool:
     """True when a caller that can order its queries by spatial locality
     ONCE (e.g. the RT-DETR decoder stack, whose six layers share one
@@ -400,6 +413,18 @@ if MSDA_SG and (
     raise ValueError(
         f"SPOTTER_TPU_MSDA_SG must be 0 or a multiple of 8 dividing "
         f"Q_TILE={Q_TILE} into at most 32 groups, got {MSDA_SG}"
+    )
+if MSDA_SG and (
+    os.environ.get("SPOTTER_TPU_MSDA_PREP", "xla").strip().lower() == "kernel"
+    or os.environ.get(MSDA_ENV, "auto").strip().lower() == "pallas_sep"
+):
+    # only the merged one-hot kernel on the XLA-prep path implements
+    # subgroup masks; silently no-op'ing the knob would record a wrong
+    # A/B conclusion — exactly what the flag exists to measure
+    raise ValueError(
+        "SPOTTER_TPU_MSDA_SG requires the merged one-hot backend with "
+        "SPOTTER_TPU_MSDA_PREP=xla (the loc-prep kernel and pallas_sep "
+        "do not implement subgroup hit bits)"
     )
 
 
@@ -791,38 +816,37 @@ def _onehot_merged_kernel(
 
             @pl.when(mask_ref[i, nq, ns] != 0)
             def _(k=k, idx=idx, w=w, ts=ts, lo=v_off):
+                def oh_chain(rows_sl):
+                    """The one one-hot build: jc (compare, select, add)
+                    chains over (rows, ts) at tile k — shared verbatim by
+                    the full-tile and per-subgroup paths so the two can
+                    never drift."""
+                    n_rows = idx[rows_sl].shape[0]
+                    col = jax.lax.broadcasted_iota(
+                        jnp.int32, (n_rows, ts), 1
+                    ) + (k * ts)
+                    oh = jnp.zeros((n_rows, ts), jnp.float32)
+                    for j in range(jc):
+                        oh = oh + jnp.where(
+                            col == idx[rows_sl, j : j + 1],
+                            w[rows_sl, j : j + 1].astype(jnp.float32),
+                            0.0,
+                        )
+                    return oh
+
                 if subgroup:
                     oh_ref = scratch[0]
                     oh_ref[:, :ts] = jnp.zeros((qt, ts), jnp.float32)
                     for g in range(qt // subgroup):
 
                         @pl.when(((mask_ref[i, nq, ns] >> g) & 1) != 0)
-                        def _(g=g, k=k, idx=idx, w=w, ts=ts):
+                        def _(g=g, ts=ts):
                             sl = slice(g * subgroup, (g + 1) * subgroup)
-                            col = jax.lax.broadcasted_iota(
-                                jnp.int32, (subgroup, ts), 1
-                            ) + (k * ts)
-                            oh = jnp.zeros((subgroup, ts), jnp.float32)
-                            for j in range(jc):
-                                oh = oh + jnp.where(
-                                    col == idx[sl, j : j + 1],
-                                    w[sl, j : j + 1].astype(jnp.float32),
-                                    0.0,
-                                )
-                            oh_ref[sl, :ts] = oh
+                            oh_ref[sl, :ts] = oh_chain(sl)
 
                     oh = oh_ref[:, :ts]
                 else:
-                    col = jax.lax.broadcasted_iota(jnp.int32, (qt, ts), 1) + (
-                        k * ts
-                    )
-                    oh = jnp.zeros((qt, ts), jnp.float32)
-                    for j in range(jc):
-                        oh = oh + jnp.where(
-                            col == idx[:, j : j + 1],
-                            w[:, j : j + 1].astype(jnp.float32),
-                            0.0,
-                        )
+                    oh = oh_chain(slice(None))
                 acc = jnp.dot(
                     oh,
                     v_ref[0, lo + k * ts : lo + (k + 1) * ts].astype(jnp.float32),
@@ -951,6 +975,12 @@ pallas_onehot_sampling_merged.defvjp(_onehot_merged_fwd, _onehot_merged_bwd)
 # tile_of(y0*W + x0) == y0 // rows_per_tile for any x0 < W), a superset
 # otherwise only for out-of-bounds corners whose weight the kernel zeroes.
 # Default stays "xla" until the on-chip A/B records a win (BASELINE.md).
+#
+# TRAINING caveat (ADVICE r3): this path's custom VJP backward runs the
+# jnp gather reference (_loc_ref) plus a forward recompute, so under
+# PREP=kernel the kernel's benefit exists in the FORWARD only — a training
+# A/B that reads end-to-end step time would misattribute the gather-cost
+# backward to the kernel. Serving (forward-only) is the intended consumer.
 
 MSDA_PREP = os.environ.get("SPOTTER_TPU_MSDA_PREP", "xla").strip().lower()
 if MSDA_PREP not in ("xla", "kernel"):

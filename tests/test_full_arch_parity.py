@@ -131,6 +131,7 @@ def test_full_r101_pipeline_parity():
     )
     assert orig == (image.height, image.width)
 
+    prev_precision = jax.config.jax_default_matmul_precision
     jax.config.update("jax_default_matmul_precision", "highest")
     built = BuiltDetector(
         model_name="parity/rtdetr_v2_r101vd",
@@ -141,8 +142,11 @@ def test_full_r101_pipeline_parity():
         id2label=coco_id2label_80(),
         num_top_queries=cfg.num_queries,
     )
-    engine = InferenceEngine(built, threshold=threshold, batch_buckets=(1,))
-    j_dets = engine.detect([image])[0]
+    try:
+        engine = InferenceEngine(built, threshold=threshold, batch_buckets=(1,))
+        j_dets = engine.detect([image])[0]
+    finally:  # global jax config: restore so later tests keep their default
+        jax.config.update("jax_default_matmul_precision", prev_precision)
 
     # --- same detections: greedy label+box matching, golden-test tolerances
     assert len(j_dets) == len(t_dets), (j_dets, t_dets)
