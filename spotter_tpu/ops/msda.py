@@ -414,17 +414,18 @@ if MSDA_SG and (
         f"SPOTTER_TPU_MSDA_SG must be 0 or a multiple of 8 dividing "
         f"Q_TILE={Q_TILE} into at most 32 groups, got {MSDA_SG}"
     )
-if MSDA_SG and (
-    os.environ.get("SPOTTER_TPU_MSDA_PREP", "xla").strip().lower() == "kernel"
-    or os.environ.get(MSDA_ENV, "auto").strip().lower() == "pallas_sep"
+if MSDA_SG and os.environ.get(MSDA_ENV, "auto").strip().lower() not in (
+    "auto",
+    "pallas",
 ):
     # only the merged one-hot kernel on the XLA-prep path implements
     # subgroup masks; silently no-op'ing the knob would record a wrong
-    # A/B conclusion — exactly what the flag exists to measure
+    # A/B conclusion — exactly what the flag exists to measure. (The
+    # PREP=kernel conflict is checked below, after MSDA_PREP is parsed.)
     raise ValueError(
-        "SPOTTER_TPU_MSDA_SG requires the merged one-hot backend with "
-        "SPOTTER_TPU_MSDA_PREP=xla (the loc-prep kernel and pallas_sep "
-        "do not implement subgroup hit bits)"
+        "SPOTTER_TPU_MSDA_SG requires the merged one-hot backend "
+        "(SPOTTER_TPU_MSDA=auto|pallas); other backends ignore subgroup "
+        "hit bits"
     )
 
 
@@ -985,6 +986,13 @@ pallas_onehot_sampling_merged.defvjp(_onehot_merged_fwd, _onehot_merged_bwd)
 MSDA_PREP = os.environ.get("SPOTTER_TPU_MSDA_PREP", "xla").strip().lower()
 if MSDA_PREP not in ("xla", "kernel"):
     raise ValueError(f"SPOTTER_TPU_MSDA_PREP must be xla|kernel, got {MSDA_PREP!r}")
+if MSDA_SG and MSDA_PREP == "kernel":
+    # the loc-prep kernel builds plain 0/1 masks (see the SG guard at the
+    # MSDA_SG definition for why silent no-ops are rejected)
+    raise ValueError(
+        "SPOTTER_TPU_MSDA_SG requires SPOTTER_TPU_MSDA_PREP=xla "
+        "(the loc-prep kernel does not implement subgroup hit bits)"
+    )
 
 
 def _onehot_merged_loc_kernel(

@@ -133,16 +133,16 @@ def test_full_r101_pipeline_parity():
 
     prev_precision = jax.config.jax_default_matmul_precision
     jax.config.update("jax_default_matmul_precision", "highest")
-    built = BuiltDetector(
-        model_name="parity/rtdetr_v2_r101vd",
-        module=RTDetrDetector(cfg),
-        params=params,
-        preprocess_spec=RTDETR_SPEC,
-        postprocess="sigmoid_topk",
-        id2label=coco_id2label_80(),
-        num_top_queries=cfg.num_queries,
-    )
     try:
+        built = BuiltDetector(
+            model_name="parity/rtdetr_v2_r101vd",
+            module=RTDetrDetector(cfg),
+            params=params,
+            preprocess_spec=RTDETR_SPEC,
+            postprocess="sigmoid_topk",
+            id2label=coco_id2label_80(),
+            num_top_queries=cfg.num_queries,
+        )
         engine = InferenceEngine(built, threshold=threshold, batch_buckets=(1,))
         j_dets = engine.detect([image])[0]
     finally:  # global jax config: restore so later tests keep their default

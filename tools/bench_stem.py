@@ -1,0 +1,72 @@
+"""Stem stage decomposition + fused-Pallas-stem A/B on the real chip.
+
+Times the deep-stem pipeline (conv0 s2 -> conv1 -> conv2 -> maxpool) stage
+by stage with loop-in-jit (tools/timing.py methodology), under the serving
+bf16 policy, to aim the Pallas fused-stem work at the true hot stages.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--stages", default="conv0,conv01,stem,stem_pool")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import linen as nn
+
+    from spotter_tpu.models.layers import ConvNorm
+    from tools.timing import timeit_loop
+
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    b = args.batch
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((b, 640, 640, 3)), jnp.float32
+    )
+
+    class Stem(nn.Module):
+        upto: int = 3
+        pool: bool = False
+
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(dt)
+            if self.upto >= 1:
+                x = ConvNorm(32, 3, 2, activation="relu", dtype=dt, name="stem0")(x)
+            if self.upto >= 2:
+                x = ConvNorm(32, 3, 1, activation="relu", dtype=dt, name="stem1")(x)
+            if self.upto >= 3:
+                x = ConvNorm(64, 3, 1, activation="relu", dtype=dt, name="stem2")(x)
+            if self.pool:
+                x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+            return x
+
+    variants = {
+        "conv0": (1, False),
+        "conv01": (2, False),
+        "stem": (3, False),
+        "stem_pool": (3, True),
+    }
+    for name in args.stages.split(","):
+        upto, pool = variants[name]
+        m = Stem(upto=upto, pool=pool)
+        params = m.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+        def step(xx, m=m, params=params):
+            return jnp.sum(m.apply({"params": params}, xx).astype(jnp.float32))
+
+        ms = timeit_loop(step, x, loop=20, iters=3)
+        print(f"{name:10s}: {ms:6.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
