@@ -405,6 +405,18 @@ Q_TILE = int(os.environ.get("SPOTTER_TPU_MSDA_QTILE", "64"))
 # ONCE per source tile over the full 64-row tile, so dot count is
 # unchanged while compare elements drop by the per-group miss rate
 # (measured span statistics: ~2.5x fewer on the stride-8 level). 0 = off.
+# Nested-select one-hot build (SPOTTER_TPU_MSDA_NEST=1): the 4 bilinear
+# corners of ONE sample point are always 4 distinct cells, so their four
+# (compare, select, add) chains can fold into a first-match select tree —
+# 4 cmp + 4 sel + 1 add per point instead of 4x(cmp+sel+add), ~25% off
+# the kernel's dominant op count. Exactness needs collision-free indices:
+# a clamped out-of-bounds corner (weight 0) can alias an in-bounds
+# neighbor's cell and would shadow its weight in first-match order, so
+# the dispatcher rewrites every weight<=0 corner's index to a unique
+# negative sentinel (never matches a column). Sum semantics are then
+# identical; the VJP reference is unchanged.
+MSDA_NEST = os.environ.get("SPOTTER_TPU_MSDA_NEST", "0") != "0"
+
 MSDA_SG = int(os.environ.get("SPOTTER_TPU_MSDA_SG", "0"))
 if MSDA_SG and (
     Q_TILE % MSDA_SG or MSDA_SG % 8 or Q_TILE // MSDA_SG > 32
@@ -414,18 +426,17 @@ if MSDA_SG and (
         f"SPOTTER_TPU_MSDA_SG must be 0 or a multiple of 8 dividing "
         f"Q_TILE={Q_TILE} into at most 32 groups, got {MSDA_SG}"
     )
-if MSDA_SG and os.environ.get(MSDA_ENV, "auto").strip().lower() not in (
-    "auto",
-    "pallas",
-):
+if (MSDA_SG or MSDA_NEST) and os.environ.get(
+    MSDA_ENV, "auto"
+).strip().lower() not in ("auto", "pallas"):
     # only the merged one-hot kernel on the XLA-prep path implements
-    # subgroup masks; silently no-op'ing the knob would record a wrong
-    # A/B conclusion — exactly what the flag exists to measure. (The
-    # PREP=kernel conflict is checked below, after MSDA_PREP is parsed.)
+    # subgroup masks / nested corner selects; silently no-op'ing a knob
+    # would record a wrong A/B conclusion — exactly what the flags exist
+    # to measure. (The PREP=kernel conflicts are checked below, after
+    # MSDA_PREP is parsed.)
     raise ValueError(
-        "SPOTTER_TPU_MSDA_SG requires the merged one-hot backend "
-        "(SPOTTER_TPU_MSDA=auto|pallas); other backends ignore subgroup "
-        "hit bits"
+        "SPOTTER_TPU_MSDA_SG/NEST require the merged one-hot backend "
+        "(SPOTTER_TPU_MSDA=auto|pallas); other backends ignore them"
     )
 
 
@@ -785,7 +796,7 @@ def _sep_level_dispatch(
 
 def _onehot_merged_kernel(
     mask_ref, idx_ref, w_ref, v_ref, out_ref, *scratch,
-    level_tiles: tuple, precision, subgroup: int = 0,
+    level_tiles: tuple, precision, subgroup: int = 0, nested: bool = False,
 ):
     # Grid is (bh, n_qt) ONLY: the s-walk over every level's tiles is a
     # static Python unroll over slices of the fully-fetched value block.
@@ -818,15 +829,30 @@ def _onehot_merged_kernel(
             @pl.when(mask_ref[i, nq, ns] != 0)
             def _(k=k, idx=idx, w=w, ts=ts, lo=v_off):
                 def oh_chain(rows_sl):
-                    """The one one-hot build: jc (compare, select, add)
-                    chains over (rows, ts) at tile k — shared verbatim by
-                    the full-tile and per-subgroup paths so the two can
-                    never drift."""
+                    """The one one-hot build over (rows, ts) at tile k —
+                    shared verbatim by the full-tile and per-subgroup paths
+                    so the two can never drift. `nested` folds each point's
+                    4 corner chains into a first-match select tree (exact
+                    under the dispatcher's sentinel-index rewrite — see
+                    MSDA_NEST)."""
                     n_rows = idx[rows_sl].shape[0]
                     col = jax.lax.broadcasted_iota(
                         jnp.int32, (n_rows, ts), 1
                     ) + (k * ts)
                     oh = jnp.zeros((n_rows, ts), jnp.float32)
+                    if nested:
+                        points = jc // 4
+                        for p in range(points):
+                            sel = jnp.zeros((n_rows, ts), jnp.float32)
+                            for c in reversed(range(4)):
+                                j = c * points + p
+                                sel = jnp.where(
+                                    col == idx[rows_sl, j : j + 1],
+                                    w[rows_sl, j : j + 1].astype(jnp.float32),
+                                    sel,
+                                )
+                            oh = oh + sel
+                        return oh
                     for j in range(jc):
                         oh = oh + jnp.where(
                             col == idx[rows_sl, j : j + 1],
@@ -885,6 +911,7 @@ def pallas_onehot_sampling_merged(
         level_tiles=level_tiles,
         precision=MSDA_MXU_PRECISION,
         subgroup=MSDA_SG,
+        nested=MSDA_NEST,
     )
     scratch_shapes = (
         [pltpu.VMEM((Q_TILE, max(t for t, _ in level_tiles)), jnp.float32)]
@@ -921,6 +948,15 @@ def pallas_onehot_sampling_merged(
         ),
         scratch_shapes=scratch_shapes,
     )
+    if MSDA_NEST:
+        # unique negative sentinels for match-incapable corners so a
+        # clamped OOB corner can never shadow a sibling's cell in the
+        # first-match select tree. Applied HERE (kernel-facing primal
+        # only): the custom-VJP residuals keep the caller's true indices,
+        # whose gather-backward needs the real corner cells even for
+        # exactly-zero-weight corners (their d_w drives the loc gradient).
+        sent = -1 - jnp.arange(jc, dtype=jnp.int32)
+        idx = jnp.where(w > 0, idx, sent)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((bh, qp, hd), jnp.float32),
@@ -992,6 +1028,11 @@ if MSDA_SG and MSDA_PREP == "kernel":
     raise ValueError(
         "SPOTTER_TPU_MSDA_SG requires SPOTTER_TPU_MSDA_PREP=xla "
         "(the loc-prep kernel does not implement subgroup hit bits)"
+    )
+if MSDA_NEST and MSDA_PREP == "kernel":
+    raise ValueError(
+        "SPOTTER_TPU_MSDA_NEST requires SPOTTER_TPU_MSDA_PREP=xla "
+        "(the loc-prep kernel builds its own corner chains)"
     )
 
 
@@ -1378,7 +1419,12 @@ def deformable_sampling(
                 c * lp + lvl * points + p for c in range(4) for p in range(points)
             ]
             # level-local indices; padded/invalid slots (global idx 0, w 0)
-            # may go negative here — they simply never match a column
+            # may go negative here — they simply never match a column.
+            # (MSDA_NEST's sentinel rewrite happens INSIDE the kernel
+            # wrapper's primal so the VJP residuals keep the true indices —
+            # the gather-based backward must read the real corner cells
+            # even for exactly-zero-weight corners, whose d_w feeds the
+            # location gradient.)
             idx_l = idx_q[:, :, cols] - np.int32(offs[lvl])
             w_l = w_q[:, :, cols]
             # hit mask: which source tiles does each query tile touch?

@@ -8,6 +8,7 @@ implements (HF modeling_rt_detr_v2 multi_scale_deformable_attention_v2).
 Pallas runs in interpret mode on the CPU test mesh (SURVEY.md §4.4).
 """
 
+import os
 import numpy as np
 import pytest
 
@@ -289,3 +290,79 @@ def test_kernel_prep_gradients_match_xla(monkeypatch):
         np.testing.assert_allclose(
             np.asarray(gk), np.asarray(gr), atol=2e-4, err_msg=name
         )
+
+
+@pytest.mark.parametrize(
+    "sg,nest", [(8, False), (0, True), (8, True)], ids=["sg8", "nest", "sg8+nest"]
+)
+def test_subgroup_and_nested_modes_match_xla(sg, nest, monkeypatch):
+    """MSDA_SG (per-sublane-group hit bits) and MSDA_NEST (first-match
+    corner select trees with sentinel indices) are exact rewrites of the
+    merged one-hot kernel — including with out-of-bounds sample points,
+    whose clamped corner indices are what the NEST sentinels exist for."""
+    monkeypatch.setattr(M, "MSDA_SG", sg)
+    monkeypatch.setattr(M, "MSDA_NEST", nest)
+    # Q_TILE=64 > Q=7: padded query rows carry zero weights through both modes
+    for method in ("default", "discrete"):
+        value, loc, attn = _random_inputs(3)
+        got = deformable_sampling(
+            value, loc, attn, SHAPES, P, method=method, backend="pallas",
+            interpret=True,
+        )
+        ref = deformable_sampling(
+            value, loc, attn, SHAPES, P, method=method, backend="xla"
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_nested_mode_gradients_match_xla(monkeypatch):
+    """NEST gradient regression: the sentinel rewrite must stay KERNEL-
+    facing only. If it leaked into the custom-VJP residuals, a valid
+    corner with exactly-zero bilinear weight (sample point ON a grid
+    line) would make the gather-backward read a clamped sentinel row and
+    corrupt the location gradient through d_w (found by review, round 4:
+    grad diff up to 10.0 before the fix)."""
+    monkeypatch.setattr(M, "MSDA_NEST", True)
+    value, loc, attn = _random_inputs(5)
+    # force several points exactly onto grid lines of the 8x8 level:
+    # x*8 - 0.5 integral -> fx == 0 with both corners in-bounds
+    loc = loc.at[:, :3, :, 0, 0].set(0.3125)
+    loc = loc.at[:, :3, :, 0, 1].set(0.5625)
+
+    def loss(bk, interp):
+        def f(v, l, a):
+            return jnp.sum(
+                deformable_sampling(
+                    v, l, a, SHAPES, P, backend=bk, interpret=interp
+                )
+                ** 2
+            )
+
+        return f
+
+    g_nest = jax.grad(loss("pallas", True), (0, 1, 2))(value, loc, attn)
+    g_ref = jax.grad(loss("xla", False), (0, 1, 2))(value, loc, attn)
+    for a, b in zip(g_nest, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_sg_nest_knob_validation():
+    """Conflicting knob combinations must raise at import, not no-op."""
+    import subprocess
+    import sys
+
+    for env in (
+        {"SPOTTER_TPU_MSDA_SG": "8", "SPOTTER_TPU_MSDA": "xla"},
+        {"SPOTTER_TPU_MSDA_NEST": "1", "SPOTTER_TPU_MSDA": "pallas_sep"},
+        {"SPOTTER_TPU_MSDA_SG": "8", "SPOTTER_TPU_MSDA_PREP": "kernel"},
+        {"SPOTTER_TPU_MSDA_NEST": "1", "SPOTTER_TPU_MSDA_PREP": "kernel"},
+        {"SPOTTER_TPU_MSDA_SG": "12"},
+    ):
+        proc = subprocess.run(
+            [sys.executable, "-c", "import spotter_tpu.ops.msda"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu", **env},
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode != 0, env
+        assert "SPOTTER_TPU_MSDA" in proc.stderr, (env, proc.stderr[-500:])
