@@ -13,7 +13,7 @@ own practice of testing the undecorated class: test_serve.py:32).
 import os
 
 from spotter_tpu.engine.batcher import MicroBatcher
-from spotter_tpu.engine.engine import InferenceEngine
+from spotter_tpu.engine.engine import InferenceEngine, default_batch_buckets
 from spotter_tpu.models import build_detector
 from spotter_tpu.serving.detector import AmenitiesDetector
 
@@ -38,10 +38,26 @@ def parse_mesh_spec(spec: str) -> dict[str, int]:
     return out
 
 
+def parse_batch_buckets(spec: str) -> tuple[int, ...]:
+    """SPOTTER_TPU_BATCH_BUCKETS: comma-separated ascending bucket ladder."""
+    try:
+        buckets = tuple(int(v) for v in spec.split(","))
+    except ValueError:
+        buckets = ()
+    if not buckets or any(b < 1 for b in buckets) or list(buckets) != sorted(
+        set(buckets)
+    ):
+        raise ValueError(
+            f"SPOTTER_TPU_BATCH_BUCKETS must be ascending positive ints, "
+            f"got {spec!r}"
+        )
+    return buckets
+
+
 def build_detector_app(
     model_name: str | None = None,
     threshold: float = DETECTION_THRESHOLD,
-    batch_buckets: tuple[int, ...] = (1, 2, 4, 8),
+    batch_buckets: tuple[int, ...] | None = None,
     max_delay_ms: float = 5.0,
     warmup: bool = False,
     mesh_spec: str | None = None,
@@ -49,6 +65,18 @@ def build_detector_app(
     model_name = model_name or os.environ.get("MODEL_NAME")
     if not model_name:
         raise ValueError("MODEL_NAME environment variable not set.")
+    if batch_buckets is None:
+        # Per-model ladder tuning is a deployment concern: R18's per-chip
+        # peak is batch 16 (485 vs 449 img/s — BASELINE.md round-4 sweep),
+        # R101's is batch 8; the default stays the conservative 8-max.
+        # `is not None` (not truthiness): an explicitly-set empty value is
+        # a malformed spec and must raise, not silently serve the default.
+        spec = os.environ.get("SPOTTER_TPU_BATCH_BUCKETS")
+        batch_buckets = (
+            parse_batch_buckets(spec)
+            if spec is not None
+            else default_batch_buckets()
+        )
 
     # Sharded serving (VERDICT r1 weak #5): SPOTTER_TPU_MESH=dp=4[,tp=2]
     # builds a mesh and the engine shards batches over "dp" / params over

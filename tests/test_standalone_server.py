@@ -7,6 +7,7 @@ from io import BytesIO
 from unittest.mock import AsyncMock
 
 import httpx
+import pytest
 import numpy as np
 from aiohttp.test_utils import TestClient, TestServer
 from PIL import Image
@@ -98,3 +99,29 @@ def test_sharded_serving_via_mesh_env(monkeypatch):
                 assert "labeled_image_base64" in img_result
 
     asyncio.run(run())
+
+
+def test_batch_buckets_env_knob(monkeypatch):
+    """SPOTTER_TPU_BATCH_BUCKETS applies the per-model ladder guidance
+    (e.g. R18's measured batch-16 peak) without code changes; malformed
+    specs fail loudly at startup, not as silent defaults."""
+    from spotter_tpu.serving.app import build_detector_app, parse_batch_buckets
+
+    assert parse_batch_buckets("1,2,4,8,16") == (1, 2, 4, 8, 16)
+    for bad in ("", "0,2", "8,4", "4,4", "a,b"):
+        with pytest.raises(ValueError):
+            parse_batch_buckets(bad)
+
+    monkeypatch.setenv("SPOTTER_TPU_BATCH_BUCKETS", "2,16")
+    detector = build_detector_app(
+        model_name="PekingU/rtdetr_v2_r18vd", threshold=0.0, max_delay_ms=1.0
+    )
+    assert detector.engine.batch_buckets == (2, 16)
+
+
+def test_batch_buckets_empty_env_fails_loudly(monkeypatch):
+    from spotter_tpu.serving.app import build_detector_app
+
+    monkeypatch.setenv("SPOTTER_TPU_BATCH_BUCKETS", "")
+    with pytest.raises(ValueError):
+        build_detector_app(model_name="PekingU/rtdetr_v2_r18vd")
