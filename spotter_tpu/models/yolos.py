@@ -24,6 +24,7 @@ from spotter_tpu.models.configs import YolosConfig
 from spotter_tpu.models.layers import (
     FLASH_ATTN_MIN_SEQ,
     MLPHead,
+    PatchEmbed,
     flash_self_attention,
     flash_attention_enabled,
     get_activation,
@@ -116,11 +117,11 @@ class YolosDetector(nn.Module):
         n_src = src_hw[0] * src_hw[1]
         t = cfg.num_detection_tokens
 
-        x = nn.Conv(
-            cfg.hidden_size, (p, p), strides=(p, p), dtype=self.dtype,
-            name="patch_projection",
-        )(pixel_values.astype(self.dtype))
-        x = x.reshape(b, gh * gw, cfg.hidden_size)
+        # row-dot patchify (layers.PatchEmbed): exact conv rewrite, ~2x on
+        # v5e for 3-channel patchify (BASELINE.md round 4)
+        x = PatchEmbed(
+            cfg.hidden_size, p, dtype=self.dtype, name="patch_projection"
+        )(pixel_values)
 
         cls_token = self.param(
             "cls_token", nn.initializers.zeros, (1, 1, cfg.hidden_size), jnp.float32
