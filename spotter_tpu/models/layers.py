@@ -151,8 +151,11 @@ def _splash_self_attention(q, k, v, interpret: bool = False):
 
     Block-size policy: pad S to a multiple of 768 so block_q=384 and a
     768-multiple block_kv always divide it; block_kv prefers the swept-best
-    2304, else the largest 768-multiple divisor (1536 or 768). Splash has no
-    sm_scale — q arrives pre-scaled, matching the flash path's sm_scale=1.
+    2304 (yolos 4608: 11.53 vs 12.49 ms/layer full-kv), else FULL-row kv
+    up to 3840 (owlv2's 3601->3840: full-kv 10.18 vs 12.67 at the old
+    768 fallback, round-4 sweep), else the largest 768-multiple divisor.
+    Splash has no sm_scale — q arrives pre-scaled, matching the flash
+    path's sm_scale=1.
     """
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as _sk,
@@ -163,7 +166,12 @@ def _splash_self_attention(q, k, v, interpret: bool = False):
 
     b, s, h, hd = q.shape
     s_pad = -(-s // 768) * 768
-    bkv = next(c for c in (_SPLASH_BKV, 1536, 768) if s_pad % c == 0)
+    if s_pad % _SPLASH_BKV == 0:
+        bkv = _SPLASH_BKV
+    elif s_pad <= 3840:
+        bkv = s_pad
+    else:
+        bkv = next(c for c in (1536, 768) if s_pad % c == 0)
     bq = min(_SPLASH_BQ, s_pad)
     bs = _sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=min(_SPLASH_BKV_COMPUTE, bkv),
