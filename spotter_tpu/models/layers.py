@@ -145,6 +145,17 @@ def flash_self_attention(q, k, v):
     return out[:, :, :s].transpose(0, 2, 1, 3)
 
 
+def _splash_block_kv(s_pad: int) -> int:
+    """block_kv for a 768-padded sequence (see _splash_self_attention's
+    block-size policy notes; swept on v5e round 3 at 4608 and round 4 at
+    3840 — tests/test_flash_attention.py pins the chosen ladder)."""
+    if s_pad % _SPLASH_BKV == 0:
+        return _SPLASH_BKV
+    if s_pad <= 3840:
+        return s_pad
+    return next(c for c in (1536, 768) if s_pad % c == 0)
+
+
 def _splash_self_attention(q, k, v, interpret: bool = False):
     """Splash-kernel backend of `flash_self_attention` (same contract:
     (B, S, H, hd) pre-scaled inputs, padded tokens isolated by segment ids).
@@ -166,12 +177,7 @@ def _splash_self_attention(q, k, v, interpret: bool = False):
 
     b, s, h, hd = q.shape
     s_pad = -(-s // 768) * 768
-    if s_pad % _SPLASH_BKV == 0:
-        bkv = _SPLASH_BKV
-    elif s_pad <= 3840:
-        bkv = s_pad
-    else:
-        bkv = next(c for c in (1536, 768) if s_pad % c == 0)
+    bkv = _splash_block_kv(s_pad)
     bq = min(_SPLASH_BQ, s_pad)
     bs = _sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=min(_SPLASH_BKV_COMPUTE, bkv),

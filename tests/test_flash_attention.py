@@ -72,3 +72,20 @@ def test_splash_interpret_matches_naive_on_cpu():
     weights = jax.nn.softmax(logits, axis=-1)
     want = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+
+def test_splash_block_kv_policy():
+    """The swept block_kv ladder (BASELINE.md rounds 3-4): 2304 when it
+    divides the padded length (yolos 4608), full-row kv up to 3840
+    (owlv2's 3601->3840: 10.18 vs 12.67 ms/layer at the old 768
+    fallback), else the 768-multiple fallback."""
+    from spotter_tpu.models.layers import _splash_block_kv
+
+    assert _splash_block_kv(4608) == 2304
+    assert _splash_block_kv(2304) == 2304
+    assert _splash_block_kv(3840) == 3840  # owlv2: full-row kv
+    assert _splash_block_kv(3072) == 3072
+    assert _splash_block_kv(1536) == 1536
+    assert _splash_block_kv(768) == 768
+    assert _splash_block_kv(6144) == 1536  # >3840, not 2304-divisible
+    assert _splash_block_kv(5376) == 768
