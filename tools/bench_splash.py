@@ -1,0 +1,58 @@
+"""Splash-attention block-size sweep at a ViT detector shape.
+
+Produced the round-4 block_kv policy (models/layers.py _splash_block_kv):
+full-row kv at s_pad=3840 (owlv2) beat the 768 fallback by 20%/layer;
+2304 stays best at 4608 (yolos). Edit the shape constants below to
+re-sweep a new family; run on the real chip. Calibrate the session's
+fori_loop floor first (BASELINE.md round-4 anchors) if absolute numbers
+matter — deltas at the same loop count cancel it.
+"""
+
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.splash_attention import splash_attention_kernel as sk
+from jax.experimental.pallas.ops.tpu.splash_attention import splash_attention_mask as sm
+
+b, h, s, hd = 8, 12, 3601, 64
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.standard_normal((b, h, s, hd)), jnp.bfloat16) * 0.125
+k = jnp.asarray(rng.standard_normal((b, h, s, hd)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((b, h, s, hd)), jnp.bfloat16)
+
+def run(s_pad, bq, bkv, bkvc):
+    bs = sk.BlockSizes(block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
+                       block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
+                       block_q_dq=bq, block_kv_dq=bkv)
+    kern = sk.make_splash_mha(mask=sm.MultiHeadMask([sm.FullMask((s_pad, s_pad))] * h),
+                              head_shards=1, q_seq_shards=1, block_sizes=bs)
+    pad = s_pad - s
+    def f(q, k, v):
+        def prep(x):
+            return jnp.pad(x, ((0,0),(0,0),(0,pad),(0,0)))
+        seg = (jnp.arange(s_pad) >= s).astype(jnp.int32)
+        segs = sk.SegmentIds(q=seg, kv=seg)
+        def body(i, c):
+            out = jax.vmap(kern, in_axes=(0,0,0,None))(prep(q + i*jnp.asarray(1e-6, q.dtype)), prep(k), prep(v), segs)
+            return c + jnp.sum(out.astype(jnp.float32))
+        return jax.lax.fori_loop(0, 8, body, jnp.float32(0))
+    jf = jax.jit(f)
+    try:
+        jax.device_get(jf(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            r = jf(q, k, v)
+        jax.device_get(r)
+        ms = (time.perf_counter()-t0)/(3*8)*1e3
+        print(f"s_pad={s_pad} bq={bq} bkv={bkv} bkvc={bkvc}: {ms:.3f} ms/layer-attn", flush=True)
+    except Exception as e:
+        print(f"s_pad={s_pad} bq={bq} bkv={bkv} bkvc={bkvc}: FAILED {str(e).splitlines()[0][:90]}", flush=True)
+
+run(3840, 384, 768, 768)    # current policy
+run(3840, 384, 1920, 960)
+run(3840, 384, 1280, 640)
+run(3840, 384, 3840, 768)
+run(4608, 384, 2304, 768)   # swept-best blocks, more padding
+
+run(3840, 256, 3840, 768)
+run(3840, 512, 3840, 768)
