@@ -1236,6 +1236,17 @@ def deformable_sampling(
     lp = loc.shape[3]
 
     chosen = msda_backend(backend, batch_heads=b * h_axis)
+    if (MSDA_SG or MSDA_NEST) and chosen != "pallas":
+        # Same contract as the import-time env guard (above, after the
+        # MSDA_SG parse) but enforced against the RESOLVED backend, so a
+        # per-call `backend=` override cannot silently no-op the knobs and
+        # record a wrong A/B conclusion — e.g. bench_msda with
+        # SPOTTER_TPU_MSDA_SG=8 --backends pallas,pallas_sep.
+        raise ValueError(
+            f"SPOTTER_TPU_MSDA_SG/NEST apply only to the merged one-hot "
+            f"backend; this call resolved backend={chosen!r}, which would "
+            f"silently ignore them"
+        )
     interp = bool(interpret) if interpret is not None else False
 
     def locality_perm():

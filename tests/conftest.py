@@ -18,8 +18,8 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 # NaN checking is off by default (it disables some fusions and slows the
-# 1-core CPU runs); individual numerical tests opt in via
-# jax.config.update("jax_debug_nans", True).
+# 1-core CPU runs); individual numerical tests opt in via the `debug_nans`
+# fixture below (SURVEY §5.2 — adopters: test_train.py, test_postprocess.py).
 os.environ.setdefault("JAX_DEBUG_NANS", "False")
 # Parity tests compare against fp32 torch; JAX's CPU backend defaults to a
 # lower-precision oneDNN path (~1e-2 drift per conv), so pin full precision.
@@ -46,3 +46,24 @@ try:
     jax.config.update("jax_default_matmul_precision", "highest")
 except Exception:
     pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def debug_nans():
+    """Run a test with `jax_debug_nans` enabled (SURVEY §5.2).
+
+    Any NaN produced inside jitted or eager numerics fails the test at the
+    producing op instead of propagating into an assertion tolerance miss.
+    Opt-in per test: it disables some fusions and re-runs de-optimized code on
+    hit, too slow to be the suite-wide default on the 1-core CPU runner.
+    """
+    import jax
+
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)

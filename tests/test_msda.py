@@ -300,17 +300,23 @@ def test_subgroup_and_nested_modes_match_xla(sg, nest, monkeypatch):
     corner select trees with sentinel indices) are exact rewrites of the
     merged one-hot kernel — including with out-of-bounds sample points,
     whose clamped corner indices are what the NEST sentinels exist for."""
-    monkeypatch.setattr(M, "MSDA_SG", sg)
-    monkeypatch.setattr(M, "MSDA_NEST", nest)
-    # Q_TILE=64 > Q=7: padded query rows carry zero weights through both modes
+    # Q_TILE=64 > Q=7: padded query rows carry zero weights through both modes.
+    # References are computed BEFORE the monkeypatch: with SG/NEST active the
+    # dispatch rejects every non-pallas backend (see the guard in
+    # deformable_sampling) rather than silently ignoring the knobs.
+    cases = []
     for method in ("default", "discrete"):
         value, loc, attn = _random_inputs(3)
+        ref = deformable_sampling(
+            value, loc, attn, SHAPES, P, method=method, backend="xla"
+        )
+        cases.append((method, value, loc, attn, ref))
+    monkeypatch.setattr(M, "MSDA_SG", sg)
+    monkeypatch.setattr(M, "MSDA_NEST", nest)
+    for method, value, loc, attn, ref in cases:
         got = deformable_sampling(
             value, loc, attn, SHAPES, P, method=method, backend="pallas",
             interpret=True,
-        )
-        ref = deformable_sampling(
-            value, loc, attn, SHAPES, P, method=method, backend="xla"
         )
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
 
@@ -322,7 +328,6 @@ def test_nested_mode_gradients_match_xla(monkeypatch):
     line) would make the gather-backward read a clamped sentinel row and
     corrupt the location gradient through d_w (found by review, round 4:
     grad diff up to 10.0 before the fix)."""
-    monkeypatch.setattr(M, "MSDA_NEST", True)
     value, loc, attn = _random_inputs(5)
     # force several points exactly onto grid lines of the 8x8 level:
     # x*8 - 0.5 integral -> fx == 0 with both corners in-bounds
@@ -340,10 +345,31 @@ def test_nested_mode_gradients_match_xla(monkeypatch):
 
         return f
 
-    g_nest = jax.grad(loss("pallas", True), (0, 1, 2))(value, loc, attn)
+    # reference first: with NEST active the dispatch rejects backend="xla"
     g_ref = jax.grad(loss("xla", False), (0, 1, 2))(value, loc, attn)
+    monkeypatch.setattr(M, "MSDA_NEST", True)
+    g_nest = jax.grad(loss("pallas", True), (0, 1, 2))(value, loc, attn)
     for a, b in zip(g_nest, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_sg_nest_reject_per_call_backend_override(monkeypatch):
+    """A per-call `backend=` override must not silently no-op SG/NEST
+    (ADVICE r4: the import-time env guard alone misses call-site overrides,
+    so an A/B harness could record a wrong conclusion)."""
+    value, loc, attn = _random_inputs(9)
+    for sg, nest in ((8, False), (0, True)):
+        monkeypatch.setattr(M, "MSDA_SG", sg)
+        monkeypatch.setattr(M, "MSDA_NEST", nest)
+        for bk in ("xla", "pallas_sep", "pallas_gather"):
+            with pytest.raises(ValueError, match="merged one-hot"):
+                deformable_sampling(
+                    value, loc, attn, SHAPES, P, backend=bk, interpret=True
+                )
+        # the merged one-hot path itself stays accepted
+        deformable_sampling(
+            value, loc, attn, SHAPES, P, backend="pallas", interpret=True
+        )
 
 
 def test_sg_nest_knob_validation():

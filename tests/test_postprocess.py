@@ -23,7 +23,7 @@ def _fake_outputs(b=2, q=10, c=5, seed=0):
     return logits, boxes, sizes
 
 
-def test_sigmoid_topk_matches_numpy_reference():
+def test_sigmoid_topk_matches_numpy_reference(debug_nans):
     logits, boxes, sizes = _fake_outputs()
     k = 7
     scores, labels, out_boxes = sigmoid_topk_postprocess(
@@ -51,7 +51,7 @@ def test_sigmoid_topk_matches_numpy_reference():
         np.testing.assert_allclose(np.asarray(out_boxes[i]), expect, rtol=1e-4)
 
 
-def test_softmax_drops_no_object_class():
+def test_softmax_drops_no_object_class(debug_nans):
     logits, boxes, sizes = _fake_outputs(c=4)
     # make the "no object" (last) class dominant everywhere; it must be ignored
     logits[..., -1] = 100.0

@@ -61,7 +61,7 @@ def test_hungarian_match_is_exact_assignment():
         assert ours_cost == pytest.approx(scipy_cost, rel=1e-5)
 
 
-def test_detection_loss_finite_and_masked():
+def test_detection_loss_finite_and_masked(debug_nans):
     rng = np.random.default_rng(1)
     cfg = tiny_rtdetr_config()
     module = RTDetrDetector(cfg)
@@ -85,7 +85,7 @@ def test_detection_loss_finite_and_masked():
     assert float(logged0["loss_bbox"]) == 0.0
 
 
-def test_train_step_descends_on_fixed_batch():
+def test_train_step_descends_on_fixed_batch(debug_nans):
     """A few steps on one batch must reduce the loss (overfit smoke test)."""
     rng = np.random.default_rng(2)
     cfg = tiny_rtdetr_config()
