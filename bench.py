@@ -24,14 +24,103 @@ import time
 import numpy as np
 
 
+def serving_slo_bench(
+    module, params, h, w, num_queries, bucket=4, delay_ms=2.0,
+    concurrency=8, n_requests=48,
+):
+    """Serving-level latency evidence (VERDICT r4 next #1): the REAL path —
+    engine + MicroBatcher under concurrent requests — measured on-chip.
+
+    Through the tunnel, per-request wall time is link-bound (each bucket-4
+    call uploads ~20 MB of pixels over ~100 MB/s; single fetched dispatches
+    carry ~80 ms RTT — BASELINE.md round 2), so the on-pod p50 estimate is
+    decomposed instead: amortized device ms/call at the SLO bucket (chained
+    dispatch, cancels per-call RTT) + the batcher's bounded queue delay +
+    measured host staging. Raw tunnel numbers are printed alongside so
+    nothing is hidden.
+    """
+    import asyncio
+
+    from PIL import Image
+
+    import dataclasses
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.engine.engine import BuiltDetector, InferenceEngine
+    from spotter_tpu.ops.preprocess import RTDETR_SPEC
+
+    built = BuiltDetector(
+        model_name="bench",
+        module=module,
+        params=params,
+        # the serving contract's spec (not a hand-built copy): the SLO row
+        # must measure the exact pipeline zoo.py serves
+        preprocess_spec=dataclasses.replace(RTDETR_SPEC, size=(h, w)),
+        postprocess="sigmoid_topk",
+        id2label={i: str(i) for i in range(80)},
+        num_top_queries=num_queries,
+    )
+    engine = InferenceEngine(built, batch_buckets=(bucket,))
+    engine.warmup()
+    batcher = MicroBatcher(engine, max_batch=bucket, max_delay_ms=delay_ms)
+    img = Image.fromarray(
+        (np.random.default_rng(0).random((h, w, 3)) * 255).astype(np.uint8)
+    )
+    lats: list[float] = []
+
+    async def drive():
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one():
+            async with sem:
+                t0 = time.perf_counter()
+                await batcher.submit(img)
+                lats.append(time.perf_counter() - t0)
+
+        await asyncio.gather(*(one() for _ in range(n_requests)))
+        await batcher.stop()
+
+    asyncio.run(drive())
+    stats = engine.metrics.snapshot()
+    return {
+        "raw_p50_ms": float(np.median(lats)) * 1e3,
+        # dispatch -> data-on-host; through the tunnel this includes the
+        # ~20 MB pixel upload the device waits on, so it is an upper bound
+        "device_window_p50_ms": stats.get("stage_device_ms_p50"),
+        # real host staging cost (PIL -> numpy -> device_put enqueue)
+        "staging_p50_ms": stats.get("stage_preprocess_ms_p50"),
+        "postprocess_p50_ms": stats.get("stage_postprocess_ms_p50"),
+        "mean_batch": stats.get("mean_batch_size"),
+        "n": len(lats),
+    }
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="rtdetr_v2_r101vd")
-    # batch 8 is the measured throughput peak (BASELINE.md); 16 verifies
-    # scaling holds. 32 adds compile minutes for no gain — opt in manually.
-    parser.add_argument("--batches", default="8,16")
+    # batch 4 is the latency-SLO bucket (within 1% of batch 8's throughput,
+    # BASELINE.md round 3); batch 8 is the measured throughput peak. 16 adds
+    # compile minutes for ~0 gain at R101 — opt in manually.
+    parser.add_argument("--batches", default="4,8")
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--baseline-per-chip", type=float, default=500.0)
+    parser.add_argument(
+        "--serving-slo",
+        default="auto",
+        choices=("auto", "on", "off"),
+        help="run the engine+MicroBatcher serving-latency section "
+        "(auto: RT-DETR models on TPU only)",
+    )
+    parser.add_argument(
+        "--int8",
+        default="auto",
+        choices=("auto", "on", "off"),
+        help="int8 MXU convs (utils/quant.py). auto = on for RT-DETR models "
+        "on TPU only (the family the CI golden-box gate validates): "
+        "measured 241.6 -> 262.6 img/s (+8.7%%) same-session at R101 "
+        "batch 8 (BASELINE.md round 5); other families stay bf16 unless "
+        "forced on",
+    )
     parser.add_argument(
         "--dtype",
         default=None,
@@ -63,6 +152,28 @@ def main() -> int:
         "bfloat16" if on_tpu else "float32"
     )
     os.environ[DTYPE_ENV] = policy
+
+    # int8 convs, also an import-time knob (utils/quant.py). An explicit env
+    # or --int8 on/off always wins; otherwise auto enables it on TPU for the
+    # RT-DETR presets ONLY — the family the CI golden-box gate
+    # (SPOTTER_TPU_INT8=1 run) validates to ±1 px. Other families' quantized
+    # accuracy is unvalidated, so their benchmarks stay bf16 unless forced.
+    # Measured +8.7% e2e (R101 batch 8, round-5 session; conv-shape probes
+    # in tools/bench_int8_conv.py). The literal env name is used here — even
+    # importing utils.quant would bake its import-time INT8 read before this
+    # setting took effect.
+    INT8_ENV = "SPOTTER_TPU_INT8"
+
+    # RTDETR_PRESETS isn't imported yet (model imports must follow the env
+    # setup); the auto gate keys on the preset naming contract instead.
+    rtdetr_like = args.model.startswith("rtdetr")
+    if args.int8 == "on":
+        os.environ[INT8_ENV] = "1"
+    elif args.int8 == "off":
+        os.environ[INT8_ENV] = "0"
+    elif INT8_ENV not in os.environ and on_tpu and rtdetr_like:
+        os.environ[INT8_ENV] = "1"
+    int8_on = os.environ.get(INT8_ENV, "0") != "0"
 
     from spotter_tpu.models.configs import (
         RTDETR_PRESETS,
@@ -165,6 +276,7 @@ def main() -> int:
     forward = jax.jit(apply_post)
 
     best = {"images_per_sec": 0.0, "batch": 0, "p50_ms": 0.0}
+    per_batch: dict[int, dict] = {}
     for batch in [int(b) for b in args.batches.split(",")]:
         pixels_np = np.random.default_rng(0).standard_normal((batch, h, w, 3)).astype(
             np.float32
@@ -196,18 +308,74 @@ def main() -> int:
             continue
         p50 = float(np.median(times))
         ips = args.iters * batch / total
+        amortized_ms = total / args.iters * 1e3
+        per_batch[batch] = {"ips": ips, "amortized_ms": amortized_ms}
         print(
-            f"# batch={batch}: {ips:.0f} img/s amortized, "
-            f"p50 single-call {p50 * 1e3:.2f} ms",
+            f"# batch={batch}: {ips:.0f} img/s amortized "
+            f"({amortized_ms:.2f} ms/call), p50 single-call {p50 * 1e3:.2f} ms",
             file=sys.stderr,
         )
         if ips > best["images_per_sec"]:
             best = {"images_per_sec": ips, "batch": batch, "p50_ms": p50 * 1e3}
 
+    # Serving-level latency-SLO row (VERDICT r4 next #1): the throughput-only
+    # headline hid that no R101 serving-latency evidence existed. The SLO
+    # bucket is 4 (within ~1% of batch 8 throughput, BASELINE.md round 3);
+    # on-pod p50 = amortized device ms/call at the bucket (chained dispatch
+    # cancels the tunnel's per-call RTT) + the batcher's bounded queue delay
+    # (2 ms) + on-pod host staging (2-4 ms measured in round 3). Raw tunnel
+    # request latency is link-bound (~20 MB pixels over ~100 MB/s) and
+    # printed un-corrected for transparency.
+    slo_note = ""
+    run_slo = args.serving_slo == "on" or (
+        args.serving_slo == "auto" and args.model in RTDETR_PRESETS and on_tpu
+    )
+    slo_bucket = 4
+    if run_slo and args.model not in RTDETR_PRESETS:
+        # serving_slo_bench builds the engine with the sigmoid_topk
+        # postprocess and no pixel mask — the RT-DETR serving contract;
+        # wiring the other families' contracts here would duplicate zoo.py
+        print(
+            f"# serving-SLO section supports the RT-DETR presets only; "
+            f"skipping for {args.model}",
+            file=sys.stderr,
+        )
+        run_slo = False
+    if run_slo and slo_bucket not in per_batch:
+        print(
+            f"# serving-SLO section needs batch {slo_bucket} in --batches "
+            f"(got {sorted(per_batch)}); skipping",
+            file=sys.stderr,
+        )
+        run_slo = False
+    if run_slo:
+        try:
+            s = serving_slo_bench(
+                module, params, h, w,
+                num_queries=getattr(cfg, "num_queries", 300), bucket=slo_bucket,
+            )
+            amort = per_batch[slo_bucket]["amortized_ms"]
+            est = amort + 2.0 + 3.0  # + queue bound + on-pod staging mid-range
+            print(
+                f"# serving-SLO bucket {slo_bucket} (MicroBatcher, concurrent "
+                f"requests): device {amort:.1f} ms/call amortized -> on-pod "
+                f"p50 est ~{est:.0f} ms; tunnel raw p50 {s['raw_p50_ms']:.0f} ms "
+                f"(link-bound), 1-core host staging {s['staging_p50_ms']:.0f} ms, "
+                f"mean batch {s['mean_batch']:.1f}",
+                file=sys.stderr,
+            )
+            slo_note = (
+                f"; SLO b{slo_bucket} p50~{est:.0f} ms on-pod est "
+                f"({amort:.1f} device + <=2 queue + 2-4 staging; "
+                f"tunnel raw {s['raw_p50_ms']:.0f} ms link-bound)"
+            )
+        except Exception as exc:
+            print(f"# serving-SLO section failed: {exc}", file=sys.stderr)
+
     result = {
         "metric": f"{args.model} images/sec/chip ({dev.platform}, "
-        f"{policy}, batch {best['batch']}, {h}x{w}, "
-        f"p50 {best['p50_ms']:.2f} ms)",
+        f"{policy}{'+int8conv' if int8_on else ''}, batch {best['batch']}, "
+        f"{h}x{w}, p50 {best['p50_ms']:.2f} ms{slo_note})",
         "value": round(best["images_per_sec"], 1),
         "unit": "images/sec",
         "vs_baseline": round(best["images_per_sec"] / args.baseline_per_chip, 3),

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from spotter_tpu.utils.quant import int8_conv, int8_wanted
+
 # GELU policy: torch's default nn.GELU / HF ACT2FN["gelu"] is the exact erf
 # form, which costs ~14 VPU transcendental-class ops per element — measured
 # 1.13 vs 0.08 ms against the tanh form at one yolos MLP activation
@@ -266,15 +268,32 @@ class ConvNorm(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         pad = (self.kernel_size - 1) // 2 if self.padding is None else self.padding
-        x = nn.Conv(
-            self.features,
-            (self.kernel_size, self.kernel_size),
-            strides=(self.stride, self.stride),
-            padding=[(pad, pad), (pad, pad)],
-            use_bias=False,
-            dtype=self.dtype,
-            name="conv",
-        )(x)
+        if int8_wanted(x.shape[-1]):
+            # Quantized path (SPOTTER_TPU_INT8=1, utils/quant.py): int8 MXU
+            # conv with the dequant feeding the same frozen-BN chain. The
+            # kernel param is declared at nn.Conv's exact path/shape/init so
+            # checkpoints and converters are unaffected.
+            kernel = ConvKernel(
+                (self.kernel_size, self.kernel_size, x.shape[-1], self.features),
+                name="conv",
+            )()
+            x = int8_conv(
+                x,
+                kernel,
+                (self.stride, self.stride),
+                [(pad, pad), (pad, pad)],
+                self.dtype,
+            )
+        else:
+            x = nn.Conv(
+                self.features,
+                (self.kernel_size, self.kernel_size),
+                strides=(self.stride, self.stride),
+                padding=[(pad, pad), (pad, pad)],
+                use_bias=False,
+                dtype=self.dtype,
+                name="conv",
+            )(x)
         x = FrozenBatchNorm(self.features, eps=self.eps, dtype=self.dtype, name="bn")(x)
         return get_activation(self.activation)(x)
 

@@ -44,6 +44,7 @@ from spotter_tpu.ops.msda import (
 )
 from spotter_tpu.ops.topk import top_k as fast_top_k
 from spotter_tpu.utils.precision import compute_dtype
+from spotter_tpu.utils.quant import int8_conv, int8_wanted
 
 
 def generate_anchors(
@@ -136,13 +137,19 @@ class RepVggBlock(nn.Module):
                 self.features, 1, x.shape[-1], self.eps, name="conv2"
             )()
             wf = w3.at[1:2, 1:2].add(w1)
-            y = jax.lax.conv_general_dilated(
-                x,
-                wf.astype(self.dtype),
-                window_strides=(1, 1),
-                padding=((1, 1), (1, 1)),
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            )
+            if int8_wanted(x.shape[-1]):
+                # int8 MXU path on the already-fused kernel (utils/quant.py):
+                # these 384-ch 3x3 convs are the encoder's measured hot spot
+                # (tools/bench_int8_conv.py: 1.5-1.6x at 80^2/40^2)
+                y = int8_conv(x, wf, (1, 1), ((1, 1), (1, 1)), self.dtype)
+            else:
+                y = jax.lax.conv_general_dilated(
+                    x,
+                    wf.astype(self.dtype),
+                    window_strides=(1, 1),
+                    padding=((1, 1), (1, 1)),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
             y = y + (b3 + b1).astype(self.dtype)
             return get_activation(self.activation)(y)
         y = ConvNorm(self.features, 3, 1, padding=1, eps=self.eps, dtype=self.dtype, name="conv1")(x)

@@ -1,0 +1,131 @@
+"""Symmetric int8 quantization for the CNN half (`SPOTTER_TPU_INT8=1`).
+
+Why this exists (VERDICT r4 next #2): the round-3 int8 rejection ("0-5%,
+not the 2x spec ratio") did not verify the lowering. Re-probed with
+asm-level evidence (tools/bench_int8.py, v5e session 2026-07-31):
+
+- the optimized HLO of an int8 x int8 -> int32 `dot_general` shows the MXU
+  op consuming `s8` operands directly (`convolution(s8, s8) -> s32`) — the
+  int8 path IS emitted by XLA on this toolchain;
+- floor-calibrated loop-in-jit: 8192^3 matmul 3.88 ms int8 vs 6.54 ms bf16
+  (283.6 TOP/s vs 168.0 TFLOP/s, 1.69x); conv shapes measured separately in
+  tools/bench_int8_conv.py.
+
+Scheme: dynamic symmetric per-tensor activation scales + per-out-channel
+weight scales, int32 accumulation, dequant folded into the frozen-BN
+multiply that already follows every conv (models/layers.py ConvNorm). No
+calibration state: the activation scale is max|x|/127 computed per call —
+XLA fuses the reduce into the producing elementwise chain, and the int8
+cast HALVES the conv's activation-read traffic, so the quantize pass is
+nearly free on the compute-bound 3x3 convs it targets.
+
+Accuracy contract: int8 is OFF by default and sits behind the same golden
+-box gate as every numerical rewrite (tests/test_golden_boxes.py runs the
+reference anchor ±1 px; tools/golden_check.py gates the Docker build).
+Reference anchor: /root/reference/apps/spotter/tests/spotter/test_serve.py
+:293-300 — the accuracy bar quantization must clear on real weights.
+"""
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+INT8_ENV = "SPOTTER_TPU_INT8"
+INT8 = os.environ.get(INT8_ENV, "0").strip() != "0"
+
+# Channel floor: small-channel convs (the stem) are lowering-bound, not
+# MXU-bound (BASELINE.md round 4 — the ~2.5 ms stem gap is a compiler/ISA
+# limitation int8 cannot touch), and quantizing them would add a quantize
+# pass for no MXU win. Contraction dim (k*k*cin) must fill the MXU.
+INT8_MIN_CH = int(os.environ.get("SPOTTER_TPU_INT8_MIN_CH", "64"))
+
+
+def int8_wanted(in_channels: int) -> bool:
+    return INT8 and in_channels >= INT8_MIN_CH
+
+
+def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(k, k, cin, cout) float -> (int8 kernel, (cout,) f32 scales).
+
+    Per-out-channel symmetric: scale_c = max|w[..., c]| / 127. Runs on
+    device per call — the kernel tensors are small (<=1.3 MB for the
+    largest R101 conv) and XLA CSEs the quantization across iterations of
+    a serving loop only when weights are donated/constant; per-call cost is
+    noise either way.
+    """
+    amax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    wq = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return wq, scale.reshape(-1).astype(jnp.float32)
+
+
+def quantize_activation(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Dynamic per-tensor symmetric: (int8 x, scalar f32 scale)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(
+        jnp.int8
+    )
+    return xq, scale
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _int8_conv_core(x, kernel, strides, padding):
+    xq, sx = quantize_activation(x)
+    wq, sw = quantize_weight(kernel)
+    y = jax.lax.conv_general_dilated(
+        xq,
+        wq,
+        window_strides=strides,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.int32,
+    )
+    return y.astype(jnp.float32) * (sx * sw)
+
+
+def _int8_conv_fwd(x, kernel, strides, padding):
+    return _int8_conv_core(x, kernel, strides, padding), (x, kernel)
+
+
+def _int8_conv_bwd(strides, padding, res, g):
+    # Straight-through estimator: the backward pass is the FLOAT conv's —
+    # round/clip are flat almost everywhere, so the true int8 gradient would
+    # silently zero the CNN half under fine-tuning (QAT convention).
+    x, kernel = res
+
+    def float_conv(xx, ww):
+        return jax.lax.conv_general_dilated(
+            xx.astype(jnp.float32),
+            ww.astype(jnp.float32),
+            window_strides=strides,
+            padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    _, vjp = jax.vjp(float_conv, x, kernel)
+    dx, dk = vjp(g.astype(jnp.float32))
+    return dx.astype(x.dtype), dk.astype(kernel.dtype)
+
+
+_int8_conv_core.defvjp(_int8_conv_fwd, _int8_conv_bwd)
+
+
+def int8_conv(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    strides: tuple[int, int],
+    padding,
+    out_dtype: jnp.dtype,
+) -> jnp.ndarray:
+    """Quantized NHWC conv: int8 x int8 -> int32 MXU, dequantized to
+    `out_dtype`. Drop-in for the float conv inside ConvNorm (the frozen-BN
+    multiply-add that follows absorbs into the dequant elementwise chain
+    under XLA fusion). Differentiable via a straight-through estimator
+    (float-conv backward), so SPOTTER_TPU_INT8=1 under the train step
+    fine-tunes instead of freezing the CNN half."""
+    strides = tuple(int(s) for s in strides)
+    padding = tuple((int(a), int(b)) for a, b in padding)
+    return _int8_conv_core(x, kernel, strides, padding).astype(out_dtype)

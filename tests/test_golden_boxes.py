@@ -90,6 +90,56 @@ def _assert_golden(result):
     return {d.label: d.box for d in image_result.detections}
 
 
+def _write_evidence(boxes: dict) -> None:
+    """Committable run record (VERDICT r4 next #5): every successful golden
+    run leaves `evidence/golden_r101.json` — boxes, per-coordinate deltas
+    against the reference goldens, and the package versions that produced
+    them. CI uploads it; a run on any egress-connected box can commit it.
+    Controlled by SPOTTER_TPU_GOLDEN_EVIDENCE (default: repo evidence/)."""
+    import datetime
+    import importlib.metadata as md
+    import json
+
+    out = Path(
+        os.environ.get(
+            "SPOTTER_TPU_GOLDEN_EVIDENCE",
+            Path(__file__).parent.parent / "evidence" / "golden_r101.json",
+        )
+    )
+    out.parent.mkdir(parents=True, exist_ok=True)
+    versions = {}
+    for pkg in ("jax", "jaxlib", "flax", "torch", "transformers", "numpy", "pillow"):
+        try:
+            versions[pkg] = md.version(pkg)
+        except md.PackageNotFoundError:
+            versions[pkg] = None
+    record = {
+        "model": MODEL_NAME,
+        "image": IMAGE.name,
+        "timestamp_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "int8": os.environ.get("SPOTTER_TPU_INT8", "0"),
+        "platform": _jax_platform(),
+        "versions": versions,
+        "golden": GOLDEN,
+        "measured": {k: [round(float(x), 4) for x in v] for k, v in boxes.items()},
+        "max_abs_delta_px": round(
+            max(
+                abs(float(m) - float(g))
+                for label in GOLDEN
+                for m, g in zip(boxes[label], GOLDEN[label])
+            ),
+            4,
+        ),
+    }
+    out.write_text(json.dumps(record, indent=2) + "\n")
+
+
+def _jax_platform() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
 def test_golden_boxes_real_checkpoint(tmp_path, monkeypatch):
     """Converted Flax R101 reproduces the reference's golden boxes, and the
     Orbax cache round-trip reproduces them identically."""
@@ -98,6 +148,7 @@ def test_golden_boxes_real_checkpoint(tmp_path, monkeypatch):
     monkeypatch.setenv(loader.CACHE_ENV, str(tmp_path / "cache"))
     built = _build_real_detector(monkeypatch)
     boxes_first = _assert_golden(_detect(built))
+    _write_evidence(boxes_first)
 
     # Second build must hit the Orbax cache (no torch conversion) and the
     # cached params must reproduce bit-identical boxes.

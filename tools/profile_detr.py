@@ -1,0 +1,133 @@
+"""detr-resnet-50 part profile (VERDICT r4 next #7): 240.6 img/s at
+batch 8 bf16 is ~0.48 of the per-chip denominator — where do the 33 ms go?
+
+Loop-in-jit parts (tools/timing.py): full forward, backbone alone,
+decoder-layer count slope, one encoder layer at memory shapes, postprocess.
+Run on the real chip; same-session deltas cancel the harness floor.
+"""
+
+import argparse
+import dataclasses
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--loop", type=int, default=10)
+    parser.add_argument(
+        "--parts", default="full,backbone,stacks,enc_layer,postprocess"
+    )
+    args = parser.parse_args()
+    parts = args.parts.split(",")
+
+    os.environ["SPOTTER_TPU_DTYPE"] = args.dtype
+
+    import jax
+    import jax.numpy as jnp
+
+    from spotter_tpu.models.configs import DetrConfig
+    from spotter_tpu.models.detr import DetrDecoderLayer, DetrDetector, DetrEncoderLayer
+    from spotter_tpu.models.resnet import ResNetBackbone
+    from spotter_tpu.ops.postprocess import softmax_postprocess
+    from spotter_tpu.utils.precision import backbone_dtype, compute_dtype
+    from tools.timing import timeit_loop
+
+    cfg = DetrConfig()
+    b, h, w = args.batch, 800, 1333
+    dt, bdt = compute_dtype(args.dtype), backbone_dtype(args.dtype)
+    rng = np.random.default_rng(0)
+    px = jnp.asarray(rng.standard_normal((b, h, w, 3)), jnp.float32)
+    masks = jnp.ones((b, h, w), jnp.float32)
+
+    fh, fw = -(-h // 32), -(-w // 32)
+    s = fh * fw
+    print(f"detr-r50 {h}x{w} b{b} {args.dtype}: feature {fh}x{fw} = {s} tokens")
+
+    if "full" in parts or "stacks" in parts:
+        variants = [(cfg.encoder_layers, cfg.decoder_layers)]
+        if "stacks" in parts:
+            variants += [(1, cfg.decoder_layers), (cfg.encoder_layers, 1), (1, 1)]
+        for el, dl in variants:
+            c = dataclasses.replace(cfg, encoder_layers=el, decoder_layers=dl)
+            mod = DetrDetector(c, dtype=dt, backbone_dtype=bdt)
+            params = mod.init(jax.random.PRNGKey(0), px[:1])["params"]
+
+            def step(v, mod=mod, params=params):
+                out = mod.apply({"params": params}, v, masks)
+                return (
+                    jnp.sum(out["logits"].astype(jnp.float32))
+                    + jnp.sum(out["pred_boxes"])
+                )
+
+            ms = timeit_loop(step, px, loop=args.loop)
+            print(f"full enc={el} dec={dl}: {ms:.2f} ms")
+
+    if "backbone" in parts:
+        bb = ResNetBackbone(cfg.backbone, dtype=bdt)
+        params = bb.init(jax.random.PRNGKey(0), px[:1])["params"]
+
+        def bstep(v):
+            return sum(
+                jnp.sum(t.astype(jnp.float32)) for t in bb.apply({"params": params}, v)
+            )
+
+        print(f"backbone alone: {timeit_loop(bstep, px, loop=args.loop):.2f} ms")
+
+    if "enc_layer" in parts:
+        layer = DetrEncoderLayer(cfg, dtype=dt)
+        src = jnp.asarray(rng.standard_normal((b, s, cfg.d_model)), dt)
+        pos = jnp.asarray(rng.standard_normal((b, s, cfg.d_model)), dt)
+        lparams = layer.init(jax.random.PRNGKey(0), src[:1], pos[:1], None)["params"]
+
+        def estep(v):
+            return jnp.sum(
+                layer.apply({"params": lparams}, v, pos, None).astype(jnp.float32)
+            )
+
+        ms = timeit_loop(estep, src, loop=args.loop)
+        print(f"one encoder layer ({s} tokens, no mask): {ms:.2f} ms "
+              f"(x{cfg.encoder_layers} = {ms * cfg.encoder_layers:.1f})")
+
+        dlayer = DetrDecoderLayer(cfg, dtype=dt)
+        q = jnp.asarray(rng.standard_normal((b, cfg.num_queries, cfg.d_model)), dt)
+        qp = jnp.asarray(rng.standard_normal((b, cfg.num_queries, cfg.d_model)), dt)
+        dparams = dlayer.init(
+            jax.random.PRNGKey(0), q[:1], qp[:1], src[:1], pos[:1], None
+        )["params"]
+
+        def dstep(v):
+            return jnp.sum(
+                dlayer.apply({"params": dparams}, q, qp, v, pos, None).astype(
+                    jnp.float32
+                )
+            )
+
+        ms = timeit_loop(dstep, src, loop=args.loop)
+        print(f"one decoder layer: {ms:.2f} ms (x{cfg.decoder_layers} = "
+              f"{ms * cfg.decoder_layers:.1f})")
+
+    if "postprocess" in parts:
+        logits = jnp.asarray(
+            rng.standard_normal((b, cfg.num_queries, cfg.num_labels + 1)), jnp.float32
+        )
+        boxes = jnp.asarray(
+            np.clip(rng.random((b, cfg.num_queries, 4)), 0.05, 0.95), jnp.float32
+        )
+        sizes = jnp.tile(jnp.asarray([[h, w]], jnp.float32), (b, 1))
+
+        def pstep(v):
+            out = softmax_postprocess(v, boxes, sizes)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in out)
+
+        print(f"postprocess: {timeit_loop(pstep, logits, loop=args.loop):.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""yolos-base part profile (VERDICT r4 next #4): 52.7 img/s is ~0.105 of
+the per-chip denominator and ~40-50% of its own FLOP bound — where?
+
+Loop-in-jit parts (tools/timing.py): full forward, one ViT layer at the
+4300-token working shape, attention alone (the splash path fires there),
+FFN alone, patchify, postprocess. 12 layers x the layer cost should
+reconstruct the full forward; whatever does not reconstruct is glue.
+Run on the real chip.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--loop", type=int, default=10)
+    parser.add_argument("--parts", default="full,layer,attn,ffn,patchify,post")
+    args = parser.parse_args()
+    parts = args.parts.split(",")
+
+    os.environ["SPOTTER_TPU_DTYPE"] = args.dtype
+
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from spotter_tpu.models.configs import YolosConfig
+    from spotter_tpu.models.layers import PatchEmbed, get_activation
+    from spotter_tpu.models.yolos import YolosAttention, YolosDetector, YolosLayer
+    from spotter_tpu.ops.postprocess import softmax_postprocess
+    from spotter_tpu.utils.precision import backbone_dtype
+    from tools.timing import timeit_loop
+
+    cfg = YolosConfig()
+    b = args.batch
+    h, w = cfg.image_size
+    bdt = backbone_dtype(args.dtype)  # ViT body follows the backbone dtype
+    rng = np.random.default_rng(0)
+    s = (h // cfg.patch_size) * (w // cfg.patch_size) + cfg.num_detection_tokens + 1
+    d = cfg.hidden_size
+    print(f"yolos-base {h}x{w} b{b} {args.dtype}: {s} tokens (pad->4608), d={d}")
+
+    if "full" in parts:
+        px = jnp.asarray(rng.standard_normal((b, h, w, 3)), jnp.float32)
+        module = YolosDetector(cfg, dtype=bdt)
+        params = module.init(jax.random.PRNGKey(0), px[:1])["params"]
+
+        def full_step(v):
+            out = module.apply({"params": params}, v)
+            return jnp.sum(out["logits"].astype(jnp.float32)) + jnp.sum(
+                out["pred_boxes"]
+            )
+
+        print(f"full forward: {timeit_loop(full_step, px, loop=args.loop):.2f} ms")
+
+    x_tok = jnp.asarray(rng.standard_normal((b, s, d)), bdt)
+
+    if "layer" in parts:
+        layer = YolosLayer(cfg, dtype=bdt)
+        lp = layer.init(jax.random.PRNGKey(0), x_tok[:1])["params"]
+        ms = timeit_loop(
+            lambda v: jnp.sum(layer.apply({"params": lp}, v).astype(jnp.float32)),
+            x_tok, loop=args.loop,
+        )
+        print(f"one layer: {ms:.2f} ms (x{cfg.num_hidden_layers} = "
+              f"{ms * cfg.num_hidden_layers:.1f} ms)")
+
+    if "attn" in parts:
+        attn = YolosAttention(cfg, dtype=bdt)
+        ap = attn.init(jax.random.PRNGKey(0), x_tok[:1])["params"]
+        ms = timeit_loop(
+            lambda v: jnp.sum(attn.apply({"params": ap}, v).astype(jnp.float32)),
+            x_tok, loop=args.loop,
+        )
+        print(f"attention block (qkv+kernel+out): {ms:.2f} ms "
+              f"(x{cfg.num_hidden_layers} = {ms * cfg.num_hidden_layers:.1f} ms)")
+
+    if "ffn" in parts:
+        class FFN(nn.Module):
+            dtype: jnp.dtype = jnp.float32
+
+            @nn.compact
+            def __call__(self, v):
+                f = nn.Dense(cfg.intermediate_size, dtype=self.dtype, name="fc1")(v)
+                f = get_activation(cfg.hidden_act)(f)
+                return nn.Dense(d, dtype=self.dtype, name="fc2")(f)
+
+        ffn = FFN(dtype=bdt)
+        fp = ffn.init(jax.random.PRNGKey(0), x_tok[:1])["params"]
+        ms = timeit_loop(
+            lambda v: jnp.sum(ffn.apply({"params": fp}, v).astype(jnp.float32)),
+            x_tok, loop=args.loop,
+        )
+        print(f"FFN (fc1+act+fc2): {ms:.2f} ms "
+              f"(x{cfg.num_hidden_layers} = {ms * cfg.num_hidden_layers:.1f} ms)")
+
+    if "patchify" in parts:
+        px = jnp.asarray(rng.standard_normal((b, h, w, 3)), jnp.float32)
+        pe = PatchEmbed(d, cfg.patch_size, dtype=bdt)
+        pp = pe.init(jax.random.PRNGKey(0), px[:1])["params"]
+        print(f"patchify (row-dot): "
+              f"{timeit_loop(lambda v: jnp.sum(pe.apply({'params': pp}, v).astype(jnp.float32)), px, loop=args.loop):.2f} ms")
+
+    if "post" in parts:
+        logits = jnp.asarray(
+            rng.standard_normal((b, cfg.num_detection_tokens, cfg.num_labels + 1)),
+            jnp.float32,
+        )
+        boxes = jnp.asarray(
+            np.clip(rng.random((b, cfg.num_detection_tokens, 4)), 0.05, 0.95),
+            jnp.float32,
+        )
+        sizes = jnp.tile(jnp.asarray([[h, w]], jnp.float32), (b, 1))
+
+        def pstep(v):
+            out = softmax_postprocess(v, boxes, sizes)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in out)
+
+        print(f"postprocess: {timeit_loop(pstep, logits, loop=args.loop):.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
