@@ -174,6 +174,17 @@ def main() -> int:
     elif INT8_ENV not in os.environ and on_tpu and rtdetr_like:
         os.environ[INT8_ENV] = "1"
     int8_on = os.environ.get(INT8_ENV, "0") != "0"
+    # The ViT families (yolos/owlvit) have no ConvNorms — their int8 surface
+    # is the QuantDense projections, gated separately
+    # (SPOTTER_TPU_INT8_DENSE). `--int8 on` for one of them enables both so
+    # the flag does what the caller means; RT-DETR keeps the measured
+    # conv-only config unless the env opts dense in explicitly.
+    vit_like = args.model in ("yolos_base", "owlvit_base", "owlv2_base")
+    if args.int8 == "on" and vit_like:
+        os.environ.setdefault("SPOTTER_TPU_INT8_DENSE", "1")
+    int8_dense_on = (
+        int8_on and os.environ.get("SPOTTER_TPU_INT8_DENSE", "0") != "0"
+    )
 
     from spotter_tpu.models.configs import (
         RTDETR_PRESETS,
@@ -374,7 +385,8 @@ def main() -> int:
 
     result = {
         "metric": f"{args.model} images/sec/chip ({dev.platform}, "
-        f"{policy}{'+int8conv' if int8_on else ''}, batch {best['batch']}, "
+        f"{policy}{'+int8conv' if int8_on else ''}"
+        f"{'+int8dense' if int8_dense_on else ''}, batch {best['batch']}, "
         f"{h}x{w}, p50 {best['p50_ms']:.2f} ms{slo_note})",
         "value": round(best["images_per_sec"], 1),
         "unit": "images/sec",

@@ -21,7 +21,12 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from spotter_tpu.utils.quant import int8_conv, int8_wanted
+from spotter_tpu.utils.quant import (
+    int8_conv,
+    int8_dense,
+    int8_dense_wanted,
+    int8_wanted,
+)
 
 # GELU policy: torch's default nn.GELU / HF ACT2FN["gelu"] is the exact erf
 # form, which costs ~14 VPU transcendental-class ops per element — measured
@@ -88,9 +93,25 @@ if _FLASH_IMPL not in ("auto", "splash", "flash"):
     )
 # splash block sizes swept on v5e at (8, 12, 4608, 64): bq/bkv 384/2304
 # (compute 768) beat 512/512, 768/768, 1536/1536, 256/2304, */4608.
+# Round-5 bq re-sweep at the same shape: bq 512 and 768 tie at 12.0
+# ms/layer vs 384's 13.6 (-12%); 512 is kept (768's full-kv variants hit
+# compile-helper OOMs) and scoped to s_pad >= 4608 where it was measured —
+# _splash_block_q below. The ADVICE-r4 3072 interpolation is now measured,
+# not extrapolated: full-row 3072 at 6.93 ms vs 1536 at 9.04 / 1024 at
+# 9.12 / 768 at 9.59 (s=3000).
 _SPLASH_BQ = 384
+_SPLASH_BQ_WIDE = 512
 _SPLASH_BKV = 2304
 _SPLASH_BKV_COMPUTE = 768
+
+
+def _splash_block_q(s_pad: int) -> int:
+    """block_q policy: 512 at the measured >=4608 wide shapes it divides
+    (yolos 4608: 12.0 vs 13.6 ms/layer), else the 384 default; both pinned
+    by tests/test_flash_attention.py."""
+    if s_pad >= 4608 and s_pad % _SPLASH_BQ_WIDE == 0:
+        return _SPLASH_BQ_WIDE
+    return min(_SPLASH_BQ, s_pad)
 
 
 def flash_attention_enabled() -> bool:
@@ -180,7 +201,7 @@ def _splash_self_attention(q, k, v, interpret: bool = False):
     b, s, h, hd = q.shape
     s_pad = -(-s // 768) * 768
     bkv = _splash_block_kv(s_pad)
-    bq = min(_SPLASH_BQ, s_pad)
+    bq = _splash_block_q(s_pad)
     bs = _sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=min(_SPLASH_BKV_COMPUTE, bkv),
         block_q_dkv=bq, block_kv_dkv=bkv,
@@ -378,6 +399,40 @@ class PatchEmbed(nn.Module):
         return out.astype(self.dtype).reshape(b, gh * gw, self.features)
 
 
+class QuantDense(nn.Module):
+    """nn.Dense-compatible projection (identical param tree: `kernel`
+    lecun-normal (in, out) + optional `bias` zeros) that takes the int8 MXU
+    path (utils/quant.py int8_dense, STE backward) when SPOTTER_TPU_INT8
+    enables it for this width. With the knob off the float path reproduces
+    nn.Dense exactly, so the torch-parity tests pin the default numerics.
+
+    Used by the ViT-family projections (yolos, OWL-ViT): their qkv/out/
+    fc1/fc2 matmuls carry most of each layer's non-attention FLOPs."""
+
+    features: int
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features),
+            jnp.float32,
+        )
+        if int8_dense_wanted(x.shape[-1]):
+            y = int8_dense(x, kernel, self.dtype)
+        else:
+            y = jnp.matmul(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 class ConvKernel(nn.Module):
     """`kernel` at the path/shape/init nn.Conv(name=...) declares it."""
 
@@ -471,7 +526,7 @@ class MultiHeadAttention(nn.Module):
             v_in = key_value_states
 
         def proj(x, name):
-            return nn.Dense(self.embed_dim, dtype=self.dtype, name=name)(x)
+            return QuantDense(self.embed_dim, dtype=self.dtype, name=name)(x)
 
         def split(x):
             return x.reshape(*x.shape[:-1], self.num_heads, head_dim)

@@ -34,6 +34,7 @@ from spotter_tpu.models.configs import (
 from spotter_tpu.models.layers import (
     MultiHeadAttention,
     PatchEmbed,
+    QuantDense,
     get_activation,
 )
 
@@ -82,9 +83,9 @@ class OwlViTLayer(nn.Module):
         h = nn.LayerNorm(
             epsilon=self.layer_norm_eps, dtype=self.dtype, name="layer_norm2"
         )(x)
-        h = nn.Dense(self.intermediate_size, dtype=self.dtype, name="fc1")(h)
+        h = QuantDense(self.intermediate_size, dtype=self.dtype, name="fc1")(h)
         h = get_activation(self.hidden_act)(h)
-        return x + nn.Dense(self.hidden_size, dtype=self.dtype, name="fc2")(h)
+        return x + QuantDense(self.hidden_size, dtype=self.dtype, name="fc2")(h)
 
 
 class OwlViTTextTower(nn.Module):

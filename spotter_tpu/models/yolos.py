@@ -25,6 +25,7 @@ from spotter_tpu.models.layers import (
     FLASH_ATTN_MIN_SEQ,
     MLPHead,
     PatchEmbed,
+    QuantDense,
     flash_self_attention,
     flash_attention_enabled,
     get_activation,
@@ -56,7 +57,7 @@ class YolosAttention(nn.Module):
         head_dim = cfg.hidden_size // heads
 
         def proj(name):
-            return nn.Dense(
+            return QuantDense(
                 cfg.hidden_size, use_bias=cfg.qkv_bias, dtype=self.dtype, name=name
             )(x).reshape(*x.shape[:-1], heads, head_dim)
 
@@ -75,7 +76,7 @@ class YolosAttention(nn.Module):
             )
             out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
         out = out.reshape(*out.shape[:-2], cfg.hidden_size)
-        return nn.Dense(cfg.hidden_size, dtype=self.dtype, name="out")(out)
+        return QuantDense(cfg.hidden_size, dtype=self.dtype, name="out")(out)
 
 
 class YolosLayer(nn.Module):
@@ -94,9 +95,9 @@ class YolosLayer(nn.Module):
         normed = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="layernorm_after"
         )(x)
-        ffn = nn.Dense(cfg.intermediate_size, dtype=self.dtype, name="fc1")(normed)
+        ffn = QuantDense(cfg.intermediate_size, dtype=self.dtype, name="fc1")(normed)
         ffn = get_activation(cfg.hidden_act)(ffn)
-        return x + nn.Dense(cfg.hidden_size, dtype=self.dtype, name="fc2")(ffn)
+        return x + QuantDense(cfg.hidden_size, dtype=self.dtype, name="fc2")(ffn)
 
 
 class YolosDetector(nn.Module):

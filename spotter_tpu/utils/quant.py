@@ -46,6 +46,20 @@ def int8_wanted(in_channels: int) -> bool:
     return INT8 and in_channels >= INT8_MIN_CH
 
 
+# Dense projections (QuantDense in models/layers.py) are a SEPARATE opt-in:
+# SPOTTER_TPU_INT8=1 reproduces exactly the conv-only config the R101/R18
+# numbers were measured with (BASELINE.md round 5), while
+# SPOTTER_TPU_INT8_DENSE=1 additionally quantizes the attention/FFN
+# projections routed through QuantDense (ViT towers, MultiHeadAttention —
+# measured +6% on yolos on top of the block-q win). Keeping the gates
+# independent also lets a golden-gate failure be bisected.
+INT8_DENSE = os.environ.get("SPOTTER_TPU_INT8_DENSE", "0").strip() != "0"
+
+
+def int8_dense_wanted(in_features: int) -> bool:
+    return INT8_DENSE and in_features >= INT8_MIN_CH
+
+
 def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(k, k, cin, cout) float -> (int8 kernel, (cout,) f32 scales).
 
@@ -62,12 +76,18 @@ def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def quantize_activation(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Dynamic per-tensor symmetric: (int8 x, scalar f32 scale)."""
-    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    """Dynamic per-SAMPLE symmetric: (int8 x, (B, 1, ..., 1) f32 scales).
+
+    Per-sample (not whole-batch) scales keep a served request's
+    quantization independent of what the MicroBatcher co-batched with it —
+    a batch-mate with an activation outlier must not shift this image's
+    boxes (review finding, round 5). Rank-1 inputs fall back to a global
+    scale."""
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(1, x.ndim)) if x.ndim > 1 else ()
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
     scale = jnp.maximum(amax, 1e-12) / 127.0
-    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(
-        jnp.int8
-    )
+    xq = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
     return xq, scale
 
 
@@ -111,6 +131,52 @@ def _int8_conv_bwd(strides, padding, res, g):
 
 
 _int8_conv_core.defvjp(_int8_conv_fwd, _int8_conv_bwd)
+
+
+@jax.custom_vjp
+def _int8_dense_core(x, kernel):
+    """(..., K) @ (K, N) with int8 operands and int32 accumulation."""
+    xq, sx = quantize_activation(x)
+    wq, sw = quantize_weight(kernel)
+    y = jax.lax.dot_general(
+        xq.reshape(-1, xq.shape[-1]),
+        wq,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = y.reshape(*x.shape[:-1], kernel.shape[-1])
+    return y.astype(jnp.float32) * (sx * sw)
+
+
+def _int8_dense_fwd(x, kernel):
+    return _int8_dense_core(x, kernel), (x, kernel)
+
+
+def _int8_dense_bwd(res, g):
+    # straight-through: the float matmul's gradients (see _int8_conv_bwd)
+    x, kernel = res
+
+    def float_dense(xx, ww):
+        return jnp.einsum(
+            "...k,kn->...n", xx.astype(jnp.float32), ww.astype(jnp.float32)
+        )
+
+    _, vjp = jax.vjp(float_dense, x, kernel)
+    dx, dk = vjp(g.astype(jnp.float32))
+    return dx.astype(x.dtype), dk.astype(kernel.dtype)
+
+
+_int8_dense_core.defvjp(_int8_dense_fwd, _int8_dense_bwd)
+
+
+def int8_dense(
+    x: jnp.ndarray, kernel: jnp.ndarray, out_dtype: jnp.dtype
+) -> jnp.ndarray:
+    """Quantized dense: drop-in for `x @ kernel` (bias stays outside — it
+    adds in float after dequant). Same scheme and STE backward as
+    `int8_conv`; the ViT families' qkv/out/fc1/fc2 projections are where
+    the matmul FLOPs live (e.g. ~52% of a yolos layer's budget)."""
+    return _int8_dense_core(x, kernel).astype(out_dtype)
 
 
 def int8_conv(

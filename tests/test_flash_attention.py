@@ -89,3 +89,18 @@ def test_splash_block_kv_policy():
     assert _splash_block_kv(768) == 768
     assert _splash_block_kv(6144) == 1536  # >3840, not 2304-divisible
     assert _splash_block_kv(5376) == 768
+
+
+def test_splash_block_q_policy():
+    """Round-5 bq sweep: 512 at the >=4608 shapes it divides (yolos 4608:
+    12.0 vs 13.6 ms/layer-attn), 384 elsewhere (3840 cannot take 512 —
+    block_q must divide s_pad — and smaller shapes were swept at 384)."""
+    from spotter_tpu.models.layers import _splash_block_q
+
+    assert _splash_block_q(4608) == 512
+    assert _splash_block_q(5120) == 512
+    assert _splash_block_q(3840) == 384  # 512 does not divide
+    assert _splash_block_q(3072) == 384  # below the measured 4608 scope
+    assert _splash_block_q(768) == 384
+    assert _splash_block_q(384) == 384
+    assert _splash_block_q(4992) == 384  # >=4608 but 512 does not divide

@@ -31,14 +31,26 @@ def test_quantize_weight_per_channel_roundtrip():
     assert (err <= np.asarray(scale)[None, None, None, :] * 0.5 + 1e-7).all()
 
 
-def test_quantize_activation_scalar_scale():
+def test_quantize_activation_per_sample_scale():
+    """Scales are per leading-axis sample: a batch-mate's outlier must not
+    coarsen this sample's quantization (serving determinism — a request's
+    boxes cannot depend on what the MicroBatcher co-batched with it)."""
     x = jnp.asarray([[1.0, -3.0], [0.5, 2.0]], jnp.float32)
     xq, s = quantize_activation(x)
-    assert xq.dtype == jnp.int8
-    np.testing.assert_allclose(float(s), 3.0 / 127.0, rtol=1e-6)
+    assert xq.dtype == jnp.int8 and s.shape == (2, 1)
     np.testing.assert_allclose(
-        np.asarray(xq, np.float32) * float(s), np.asarray(x), atol=float(s) / 2 + 1e-7
+        np.asarray(s)[:, 0], [3.0 / 127.0, 2.0 / 127.0], rtol=1e-6
     )
+    np.testing.assert_allclose(
+        np.asarray(xq, np.float32) * np.asarray(s),
+        np.asarray(x),
+        atol=float(np.asarray(s).max()) / 2 + 1e-7,
+    )
+    # sample 0 unchanged when its batch-mate changes
+    x2 = x.at[1].mul(100.0)
+    xq2, s2 = quantize_activation(x2)
+    np.testing.assert_array_equal(np.asarray(xq2[0]), np.asarray(xq[0]))
+    np.testing.assert_allclose(float(s2[0, 0]), float(s[0, 0]), rtol=1e-7)
 
 
 def test_int8_conv_approximates_float_conv():
@@ -81,6 +93,58 @@ def test_int8_conv_gradients_are_straight_through():
     for a, b in zip(gq, gf):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
         assert float(jnp.abs(a).max()) > 0  # not silently zeroed
+
+
+def test_quant_dense_matches_nn_dense_param_tree_and_output():
+    """QuantDense with the knob off must BE nn.Dense: same param paths,
+    shapes, and (given the same params) identical outputs — the ViT torch-
+    parity tests rest on this."""
+    from flax import linen as nn
+
+    from spotter_tpu.models.layers import QuantDense
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((2, 5, 32)), jnp.float32)
+    ref = nn.Dense(16)
+    got = QuantDense(16)
+    pref = ref.init(jax.random.PRNGKey(7), x)["params"]
+    pgot = got.init(jax.random.PRNGKey(7), x)["params"]
+    assert jax.tree_util.tree_structure(pref) == jax.tree_util.tree_structure(pgot)
+
+    def by_path(tree):
+        return sorted(
+            (jax.tree_util.keystr(path), leaf.shape)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(tree)
+        )
+
+    assert by_path(pref) == by_path(pgot)
+    np.testing.assert_allclose(
+        np.asarray(ref.apply({"params": pref}, x)),
+        np.asarray(got.apply({"params": pref}, x)),
+        rtol=1e-6,
+        atol=1e-6,
+    )
+
+
+def test_int8_dense_approximates_and_ste_grads():
+    from spotter_tpu.utils.quant import int8_dense
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((64, 48)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((48, 24)) * 0.1, jnp.float32)
+    ref = x @ w
+    got = int8_dense(x, w, jnp.float32)
+    rel = np.abs(np.asarray(got) - np.asarray(ref)).max() / np.abs(np.asarray(ref)).max()
+    assert rel < 0.02, rel
+
+    gq = jax.grad(lambda a, b: jnp.sum(int8_dense(a, b, jnp.float32) ** 2), (0, 1))(x, w)
+    # STE: gradients of sum(f^2) differ between quantized/float f, so check
+    # against the float-backward applied at the quantized output cotangent
+    cot = 2 * got
+    _, vjp = jax.vjp(lambda a, b: a @ b, x, w)
+    gf = vjp(cot)
+    for a, b in zip(gq, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
 
 
 def test_int8_env_keeps_param_tree_and_output_close():

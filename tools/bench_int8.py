@@ -125,9 +125,15 @@ def hlo_evidence(n=4096):
     ]
     for ln in hits[:40]:
         print("  ", ln[:200])
-    s8_dots = [ln for ln in hits if "dot(" in ln and "s8" in ln]
+    # this toolchain lowers the int8 dot as `convolution(s8, s8) -> s32`,
+    # so the verdict must accept either spelling of the MXU op
+    s8_dots = [
+        ln
+        for ln in hits
+        if ("dot(" in ln or "convolution(" in ln) and "s8" in ln
+    ]
     print(
-        f"--- verdict: {len(s8_dots)} dot line(s) with s8 operands; "
+        f"--- verdict: {len(s8_dots)} MXU op line(s) with s8 operands; "
         f"{'int8 path EMITTED' if s8_dots else 'int8 path NOT in optimized HLO'}"
     )
     return txt

@@ -33,15 +33,20 @@ from jax.experimental.pallas.ops.tpu.splash_attention import (
 
 def run(q, k, v, s, s_pad, bq, bkv, bkvc, loop=8, iters=3):
     h = q.shape[1]
-    bs = sk.BlockSizes(
-        block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
-        block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
-        block_q_dq=bq, block_kv_dq=bkv,
-    )
-    kern = sk.make_splash_mha(
-        mask=sm.MultiHeadMask([sm.FullMask((s_pad, s_pad))] * h),
-        head_shards=1, q_seq_shards=1, block_sizes=bs,
-    )
+    try:
+        bs = sk.BlockSizes(
+            block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
+            block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
+            block_q_dq=bq, block_kv_dq=bkv,
+        )
+        kern = sk.make_splash_mha(
+            mask=sm.MultiHeadMask([sm.FullMask((s_pad, s_pad))] * h),
+            head_shards=1, q_seq_shards=1, block_sizes=bs,
+        )
+    except Exception as e:  # e.g. block size not dividing s_pad
+        print(f"s_pad={s_pad} bq={bq} bkv={bkv} bkvc={bkvc}: "
+              f"FAILED {str(e).splitlines()[0][:90]}", flush=True)
+        return
     pad = s_pad - s
 
     def f(q, k, v):
