@@ -57,7 +57,10 @@ INT8_DENSE = os.environ.get("SPOTTER_TPU_INT8_DENSE", "0").strip() != "0"
 
 
 def int8_dense_wanted(in_features: int) -> bool:
-    return INT8_DENSE and in_features >= INT8_MIN_CH
+    # "additionally": dense quantization is an extension OF the int8 mode,
+    # never active without it (INT8_DENSE=1 alone is a no-op) — keeps
+    # bench/serving labels and the golden-gate bisection truthful
+    return INT8 and INT8_DENSE and in_features >= INT8_MIN_CH
 
 
 def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -84,7 +87,9 @@ def quantize_activation(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     boxes (review finding, round 5). Rank-1 inputs fall back to a global
     scale."""
     xf = x.astype(jnp.float32)
-    axes = tuple(range(1, x.ndim)) if x.ndim > 1 else ()
+    # rank-1: one global scale (axis=() would reduce over NOTHING and
+    # yield per-element scales)
+    axes = tuple(range(1, x.ndim)) if x.ndim > 1 else (0,)
     amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
     scale = jnp.maximum(amax, 1e-12) / 127.0
     xq = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
