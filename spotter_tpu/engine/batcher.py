@@ -10,6 +10,16 @@ N+1 stages on host — jit dispatch is async and thread-safe, so the two
 worker threads interleave host staging with device compute instead of
 serializing. Per-image error containment is preserved: a failed batch
 rejects only its own futures.
+
+Request-lifecycle hardening (ISSUE 1): the queue is bounded
+(`SPOTTER_TPU_QUEUE_DEPTH`) and a full queue sheds with `QueueFullError`
+instead of buffering unboundedly; `submit()` takes an optional `Deadline`
+and raises `DeadlineExceededError` instead of waiting past it; a watchdog
+(`SPOTTER_TPU_BATCH_TIMEOUT_MS`) fails a hung `engine.detect` call's futures
+and releases its in-flight slot instead of deadlocking the pump; a
+`CircuitBreaker` trips after consecutive batch failures and sheds at
+admission while open; `drain()` stops admitting, flushes the queue, and
+waits for in-flight batches (the k8s preStop hook).
 """
 
 import asyncio
@@ -19,6 +29,29 @@ from typing import Optional
 from PIL import Image
 
 from spotter_tpu.engine.engine import InferenceEngine
+from spotter_tpu.serving.resilience import (
+    BATCH_TIMEOUT_ENV,
+    DEFAULT_BATCH_TIMEOUT_MS,
+    DEFAULT_DRAIN_TIMEOUT_S,
+    DEFAULT_QUEUE_DEPTH,
+    DRAIN_TIMEOUT_ENV,
+    QUEUE_DEPTH_ENV,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DrainingError,
+    QueueFullError,
+    _env_float,
+    _env_int,
+)
+from spotter_tpu.testing import faults
+
+
+class BatchTimeoutError(RuntimeError):
+    """The watchdog gave up on a hung engine call; the batch's futures fail
+    with this instead of waiting forever (the orphaned worker thread keeps
+    running — Python can't kill it — but its slot is released and its result
+    discarded)."""
 
 
 class MicroBatcher:
@@ -28,22 +61,52 @@ class MicroBatcher:
         max_batch: Optional[int] = None,
         max_delay_ms: float = 5.0,
         max_in_flight: int = 2,
+        max_queue: Optional[int] = None,
+        batch_timeout_ms: Optional[float] = None,
+        breaker: Optional[CircuitBreaker] = None,
     ) -> None:
+        """`max_queue`/`batch_timeout_ms` default from the env knobs
+        (`SPOTTER_TPU_QUEUE_DEPTH`, `SPOTTER_TPU_BATCH_TIMEOUT_MS`);
+        `max_queue <= 0` means unbounded, `batch_timeout_ms <= 0` disables
+        the watchdog."""
         self.engine = engine
         self.max_batch = max_batch or engine.batch_buckets[-1]
         self.max_delay_s = max_delay_ms / 1000.0
         self.max_in_flight = max(1, max_in_flight)
-        self._queue: asyncio.Queue = asyncio.Queue()
+        if max_queue is None:
+            max_queue = _env_int(QUEUE_DEPTH_ENV, DEFAULT_QUEUE_DEPTH)
+        self.max_queue = max_queue
+        if batch_timeout_ms is None:
+            batch_timeout_ms = _env_float(BATCH_TIMEOUT_ENV, DEFAULT_BATCH_TIMEOUT_MS)
+        self.batch_timeout_s = batch_timeout_ms / 1000.0 if batch_timeout_ms > 0 else None
+        self.breaker = breaker or CircuitBreaker.from_env(metrics=engine.metrics)
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max(0, max_queue))
         self._pump_task: Optional[asyncio.Task] = None
         self._in_flight: set[asyncio.Task] = set()
         self._slots: Optional[asyncio.Semaphore] = None
+        self._closed = False
+        self._draining = False
+        # True while the pump holds a dequeued-but-undispatched batch in
+        # hand — drain() must not treat "queue empty, nothing in flight" as
+        # done while a batch sits here, or stop() would fail its futures
+        self._pump_busy = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining or self._closed
 
     async def start(self) -> None:
+        """Idempotent; an explicit start() after stop()/drain() re-opens the
+        batcher (submit() never restarts a stopped batcher on its own)."""
         if self._pump_task is None:
+            self._closed = False
+            self._draining = False
+            self.engine.metrics.set_draining(False)
             self._slots = asyncio.Semaphore(self.max_in_flight)
             self._pump_task = asyncio.create_task(self._pump())
 
     async def stop(self) -> None:
+        self._closed = True
         if self._pump_task is not None:
             self._pump_task.cancel()
             try:
@@ -56,21 +119,84 @@ class MicroBatcher:
             await asyncio.gather(*self._in_flight, return_exceptions=True)
         # … then fail anything still queued so no submit() caller waits forever
         while not self._queue.empty():
-            _, fut = self._queue.get_nowait()
+            _, fut, _ = self._queue.get_nowait()
             if not fut.done():
-                fut.set_exception(RuntimeError("MicroBatcher stopped"))
+                fut.set_exception(DrainingError("MicroBatcher stopped"))
 
-    async def submit(self, image: Image.Image) -> list[dict]:
-        """One image in, its detections out (awaits the batched device call)."""
+    async def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful shutdown (k8s preStop): stop admitting, let the pump flush
+        the queue, wait for in-flight batches, then stop. Returns a summary;
+        on timeout any leftovers are failed by stop() rather than stranded."""
+        if timeout_s is None:
+            timeout_s = _env_float(DRAIN_TIMEOUT_ENV, DEFAULT_DRAIN_TIMEOUT_S)
+        t0 = time.monotonic()
+        self._draining = True
+        self.engine.metrics.set_draining(True)
+        deadline = t0 + timeout_s
+        while (
+            not self._queue.empty() or self._pump_busy or self._in_flight
+        ) and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        leftover = self._queue.qsize()
+        await self.stop()
+        return {
+            "status": "drained" if leftover == 0 else "drain_timeout",
+            "queued_failed": leftover,
+            "waited_ms": (time.monotonic() - t0) * 1000.0,
+        }
+
+    async def submit(self, image: Image.Image, deadline: Optional[Deadline] = None) -> list[dict]:
+        """One image in, its detections out (awaits the batched device call).
+
+        Raises `DrainingError` / `CircuitOpenError` / `QueueFullError` at
+        admission and `DeadlineExceededError` when `deadline` expires before
+        the result lands; every caller gets an answer in bounded time.
+        """
+        metrics = self.engine.metrics
+        if self.draining:
+            metrics.record_shed()
+            raise DrainingError("MicroBatcher is draining or stopped")
         await self.start()
+        if not self.breaker.allow():
+            metrics.record_shed()
+            raise CircuitOpenError(
+                "circuit breaker open (engine failing)",
+                retry_after_s=self.breaker.retry_after_s(),
+            )
+        if deadline is not None and deadline.expired():
+            metrics.record_deadline_exceeded()
+            raise deadline.exceeded("queue admission")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((image, fut))
-        return await fut
+        try:
+            self._queue.put_nowait((image, fut, deadline))
+        except asyncio.QueueFull:
+            metrics.record_shed()
+            raise QueueFullError(
+                f"batch queue full ({self.max_queue} deep)",
+                retry_after_s=max(self.max_delay_s * 2.0, 0.05),
+            ) from None
+        if deadline is None:
+            return await fut
+        try:
+            # shield: wait_for must not cancel the pump's handle on the
+            # future; on expiry we cancel it ourselves so the pump (which
+            # checks fut.done()) skips the dead entry
+            return await asyncio.wait_for(
+                asyncio.shield(fut), max(deadline.remaining(), 0.0)
+            )
+        except asyncio.TimeoutError:
+            fut.cancel()
+            metrics.record_deadline_exceeded()
+            raise deadline.exceeded("batched detect") from None
 
     async def _pump(self) -> None:
         while True:
-            image, fut = await self._queue.get()
-            batch = [(image, fut)]
+            self._pump_busy = False
+            first = await self._queue.get()
+            self._pump_busy = True
+            if first[1].done():  # deadline-cancelled while queued
+                continue
+            batch = [first]
             try:
                 deadline = time.monotonic() + self.max_delay_s
                 while len(batch) < self.max_batch:
@@ -78,36 +204,66 @@ class MicroBatcher:
                     if timeout <= 0:
                         break
                     try:
-                        batch.append(
-                            await asyncio.wait_for(self._queue.get(), timeout)
-                        )
+                        item = await asyncio.wait_for(self._queue.get(), timeout)
                     except asyncio.TimeoutError:
                         break
+                    if not item[1].done():
+                        batch.append(item)
                 await self._slots.acquire()
             except asyncio.CancelledError:
                 # stop() cancelled us while we hold a drained batch that no
                 # in-flight task owns yet — fail its futures or their
                 # submit() callers would wait forever
-                for _, f in batch:
+                for _, f, _ in batch:
                     if not f.done():
-                        f.set_exception(RuntimeError("MicroBatcher stopped"))
+                        f.set_exception(DrainingError("MicroBatcher stopped"))
                 raise
             task = asyncio.create_task(self._run_batch(batch))
             self._in_flight.add(task)
             task.add_done_callback(self._in_flight.discard)
 
+    def _call_engine(self, images: list[Image.Image]) -> list[list[dict]]:
+        """Runs in the worker thread; the fault hook may hang or raise here,
+        exactly where a wedged device call would."""
+        faults.on_engine_batch(len(images))
+        return self.engine.detect(images)
+
     async def _run_batch(self, batch) -> None:
         try:
+            # deadline-cancelled entries waiting for this slot are dead weight
+            batch = [item for item in batch if not item[1].done()]
+            if not batch:
+                return
             images = [b[0] for b in batch]
             try:
-                results = await asyncio.to_thread(self.engine.detect, images)
-            except Exception as exc:  # contain failure to this batch only
-                self.engine.metrics.record_error(len(batch))
-                for _, f in batch:
+                detect = asyncio.to_thread(self._call_engine, images)
+                if self.batch_timeout_s is not None:
+                    results = await asyncio.wait_for(detect, self.batch_timeout_s)
+                else:
+                    results = await detect
+            except asyncio.TimeoutError:
+                # watchdog: the engine call is wedged — fail this batch and
+                # release the slot; the breaker decides whether to keep
+                # admitting (the orphaned thread's eventual result is dropped)
+                self.engine.metrics.record_batch_timeout(len(batch))
+                self.breaker.record_failure()
+                exc = BatchTimeoutError(
+                    f"engine batch of {len(batch)} timed out after "
+                    f"{self.batch_timeout_s:.1f} s (watchdog)"
+                )
+                for _, f, _ in batch:
                     if not f.done():
                         f.set_exception(exc)
                 return
-            for (_, f), dets in zip(batch, results):
+            except Exception as exc:  # contain failure to this batch only
+                self.engine.metrics.record_error(len(batch))
+                self.breaker.record_failure()
+                for _, f, _ in batch:
+                    if not f.done():
+                        f.set_exception(exc)
+                return
+            self.breaker.record_success()
+            for (_, f, _), dets in zip(batch, results):
                 if not f.done():
                     f.set_result(dets)
         finally:

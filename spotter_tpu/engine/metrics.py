@@ -24,6 +24,14 @@ class Metrics:
         # corrupt each other's view
         self._arrivals: deque[tuple[float, int]] = deque(maxlen=window)
         self._stages: dict[str, deque[float]] = {}
+        # Resilience counters (ISSUE 1): overload shedding, deadline expiry,
+        # watchdog batch timeouts, breaker state/transitions, drain state.
+        self._shed_total = 0
+        self._deadline_exceeded_total = 0
+        self._batch_timeouts_total = 0
+        self._breaker_state = "closed"
+        self._breaker_transitions_total = 0
+        self._draining = False
 
     def record_batch(
         self,
@@ -52,6 +60,30 @@ class Metrics:
         with self._lock:
             self._errors_total += n
 
+    def record_shed(self, n: int = 1) -> None:
+        """A request rejected at admission (queue full / breaker open / drain)."""
+        with self._lock:
+            self._shed_total += n
+
+    def record_deadline_exceeded(self, n: int = 1) -> None:
+        with self._lock:
+            self._deadline_exceeded_total += n
+
+    def record_batch_timeout(self, n_images: int) -> None:
+        """Watchdog fired on a hung engine call; images count as errors too."""
+        with self._lock:
+            self._batch_timeouts_total += 1
+            self._errors_total += n_images
+
+    def record_breaker_transition(self, state: str) -> None:
+        with self._lock:
+            self._breaker_state = state
+            self._breaker_transitions_total += 1
+
+    def set_draining(self, draining: bool) -> None:
+        with self._lock:
+            self._draining = draining
+
     def snapshot(self) -> dict:
         with self._lock:
             lats = sorted(self._latencies_ms)
@@ -79,6 +111,12 @@ class Metrics:
                 **stage_p50,
                 "images_total": self._images_total,
                 "errors_total": self._errors_total,
+                "shed_total": self._shed_total,
+                "deadline_exceeded_total": self._deadline_exceeded_total,
+                "batch_timeouts_total": self._batch_timeouts_total,
+                "breaker_state": self._breaker_state,
+                "breaker_transitions_total": self._breaker_transitions_total,
+                "draining": self._draining,
                 "batches_total": self._batches_total,
                 "mean_batch_size": (
                     sum(self._batch_sizes) / len(self._batch_sizes) if self._batch_sizes else 0.0

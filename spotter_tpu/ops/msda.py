@@ -438,6 +438,21 @@ if (MSDA_SG or MSDA_NEST) and os.environ.get(
         "SPOTTER_TPU_MSDA_SG/NEST require the merged one-hot backend "
         "(SPOTTER_TPU_MSDA=auto|pallas); other backends ignore them"
     )
+if (MSDA_SG or MSDA_NEST) and os.environ.get(
+    MSDA_ENV, "auto"
+).strip().lower() == "auto":
+    # ADVICE r5 #3: under `auto`, CPU/GPU hosts resolve to the XLA backend
+    # and the knobs would be silently ignored — or, worse, abort every
+    # forward if checked per call. Fail fast HERE, at import, where the
+    # operator set the env; the call-time check below is reserved for
+    # explicit per-call `backend=` overrides. (Exported knobs on a TPU host
+    # still work: auto resolves to pallas there.)
+    if jax.default_backend() != "tpu":
+        raise ValueError(
+            f"SPOTTER_TPU_MSDA_SG/NEST require the pallas backend, but "
+            f"SPOTTER_TPU_MSDA=auto resolves to 'xla' on this "
+            f"{jax.default_backend()!r} host — unset the knobs or run on TPU"
+        )
 
 
 def _mxu_precision() -> jax.lax.Precision:
@@ -1236,16 +1251,19 @@ def deformable_sampling(
     lp = loc.shape[3]
 
     chosen = msda_backend(backend, batch_heads=b * h_axis)
-    if (MSDA_SG or MSDA_NEST) and chosen != "pallas":
-        # Same contract as the import-time env guard (above, after the
-        # MSDA_SG parse) but enforced against the RESOLVED backend, so a
-        # per-call `backend=` override cannot silently no-op the knobs and
-        # record a wrong A/B conclusion — e.g. bench_msda with
-        # SPOTTER_TPU_MSDA_SG=8 --backends pallas,pallas_sep.
+    if (MSDA_SG or MSDA_NEST) and backend is not None and chosen != "pallas":
+        # Same contract as the import-time env guards (above, after the
+        # MSDA_SG parse) but scoped to EXPLICIT per-call `backend=`
+        # overrides, so e.g. bench_msda with SPOTTER_TPU_MSDA_SG=8
+        # --backends pallas,pallas_sep cannot silently no-op the knobs and
+        # record a wrong A/B conclusion. Auto resolution is NOT re-checked
+        # here: the import-time guard already rejected hosts where auto
+        # cannot mean pallas (ADVICE r5 #3 — the old resolved-backend check
+        # aborted every CPU/GPU forward under exported knobs).
         raise ValueError(
             f"SPOTTER_TPU_MSDA_SG/NEST apply only to the merged one-hot "
-            f"backend; this call resolved backend={chosen!r}, which would "
-            f"silently ignore them"
+            f"backend; this call's explicit backend={chosen!r} override "
+            f"would silently ignore them"
         )
     interp = bool(interpret) if interpret is not None else False
 

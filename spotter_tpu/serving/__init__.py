@@ -1,1 +1,12 @@
-from spotter_tpu.serving.detector import AmenitiesDetector  # noqa: F401
+"""Serving package. `AmenitiesDetector` is re-exported lazily (PEP 562):
+`engine.batcher` imports `serving.resilience`, and an eager detector import
+here would close a cycle (detector -> batcher -> serving package init ->
+detector) whenever the batcher is imported before the serving package."""
+
+
+def __getattr__(name: str):
+    if name == "AmenitiesDetector":
+        from spotter_tpu.serving.detector import AmenitiesDetector
+
+        return AmenitiesDetector
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

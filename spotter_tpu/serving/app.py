@@ -118,7 +118,14 @@ def build_detector_app(
     )
     if warmup:
         engine.warmup()
-    batcher = MicroBatcher(engine, max_delay_ms=max_delay_ms)
+    # Resilience knobs (ISSUE 1) ride the environment into the batcher:
+    # SPOTTER_TPU_QUEUE_DEPTH (bounded admission queue),
+    # SPOTTER_TPU_BATCH_TIMEOUT_MS (hung-engine watchdog),
+    # SPOTTER_TPU_BREAKER_THRESHOLD / _COOLDOWN_S (circuit breaker) are read
+    # inside MicroBatcher/CircuitBreaker; SPOTTER_TPU_MAX_IN_FLIGHT is the
+    # dispatch-depth knob that already existed as a constructor arg.
+    max_in_flight = int(os.environ.get("SPOTTER_TPU_MAX_IN_FLIGHT", "2"))
+    batcher = MicroBatcher(engine, max_delay_ms=max_delay_ms, max_in_flight=max_in_flight)
     return AmenitiesDetector(engine, batcher)
 
 

@@ -17,6 +17,16 @@ bit-for-bit at the /detect wire:
 
 The difference is under the hood: detection goes through the MicroBatcher into
 the jit-compiled TPU engine instead of a per-image torch forward.
+
+Request-lifecycle hardening (ISSUE 1): an optional per-request `Deadline`
+(env `SPOTTER_TPU_REQUEST_DEADLINE_MS`) bounds fetch+retries, queue wait, and
+the device call — on expiry the image gets a structured
+`DetectionErrorResult` ("Deadline exceeded: ...") instead of hanging through
+22+ s of retry backoff. Admission rejections (queue full, breaker open,
+draining) stay per-image errors when the request is partially served, but a
+fully-shed request re-raises so the HTTP layer can answer 429/503 with
+Retry-After. tenacity is optional: when absent (minimal images) a local
+retry loop preserves the same 3-attempt/4-10 s-backoff contract.
 """
 
 import asyncio
@@ -26,7 +36,13 @@ from io import BytesIO
 
 import httpx
 from PIL import Image, ImageDraw
-from tenacity import AsyncRetrying, stop_after_attempt, wait_exponential
+
+try:
+    from tenacity import AsyncRetrying, stop_after_attempt, wait_exponential
+
+    _HAVE_TENACITY = True
+except ImportError:  # minimal image — fallback loop below keeps the contract
+    _HAVE_TENACITY = False
 
 from spotter_tpu.engine.batcher import MicroBatcher
 from spotter_tpu.engine.engine import InferenceEngine
@@ -38,7 +54,16 @@ from spotter_tpu.schemas import (
     DetectionSuccessResult,
     ImageResult,
 )
+from spotter_tpu.serving.resilience import (
+    AdmissionError,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceededError,
+    DrainingError,
+)
 from spotter_tpu.taxonomy import AMENITIES_MAPPING
+from spotter_tpu.testing import faults
 
 # Fetch retry policy (serve.py:84-88). Module-level so tests can zero the
 # backoff instead of sleeping through it.
@@ -61,12 +86,17 @@ class AmenitiesDetector:
         self.client = client or httpx.AsyncClient()
 
     async def _fetch_image_bytes(self, url: str) -> bytes:
+        injected = await faults.on_fetch(url)
+        if injected is not None:
+            return injected
         response = await self.client.get(url)
         response.raise_for_status()
         return response.content
 
-    async def _process_single_image(self, url: str) -> ImageResult:
-        try:
+    async def _fetch_with_retries(self, url: str) -> bytes:
+        """3 attempts, exponential backoff in [min, max] s, reraise — the
+        reference policy, with or without tenacity installed."""
+        if _HAVE_TENACITY:
             image_bytes = None
             retries = AsyncRetrying(
                 stop=stop_after_attempt(FETCH_RETRY_ATTEMPTS),
@@ -80,11 +110,34 @@ class AmenitiesDetector:
                     image_bytes = await self._fetch_image_bytes(url)
             if image_bytes is None:
                 raise Exception("Failed to fetch image after retries")
+            return image_bytes
+        for attempt in range(1, FETCH_RETRY_ATTEMPTS + 1):
+            try:
+                return await self._fetch_image_bytes(url)
+            except Exception:
+                if attempt == FETCH_RETRY_ATTEMPTS:
+                    raise
+                wait = min(
+                    max(float(2**attempt), FETCH_RETRY_WAIT_MIN_S),
+                    FETCH_RETRY_WAIT_MAX_S,
+                )
+                await asyncio.sleep(wait)
+        raise Exception("Failed to fetch image after retries")  # unreachable
+
+    async def _process_single_image(
+        self, url: str, deadline: Deadline | None = None
+    ) -> ImageResult:
+        try:
+            fetch = self._fetch_with_retries(url)
+            if deadline is not None:
+                image_bytes = await deadline.wait_for(fetch, "image fetch")
+            else:
+                image_bytes = await fetch
 
             with Image.open(BytesIO(image_bytes)) as img_raw:
                 image = img_raw.convert("RGB")
 
-            raw_detections = await self.batcher.submit(image)
+            raw_detections = await self.batcher.submit(image, deadline=deadline)
 
             draw = ImageDraw.Draw(image)
             image_detections: list[DetectionResult] = []
@@ -110,16 +163,41 @@ class AmenitiesDetector:
             return DetectionSuccessResult(
                 url=url, detections=image_detections, labeled_image_base64=image_b64
             )
+        except DeadlineExceededError as e:
+            # structured, bounded-time answer — never a hang (ISSUE 1)
+            return DetectionErrorResult(url=url, error=f"Deadline exceeded: {e}")
+        except AdmissionError:
+            # propagate so detect() can turn a fully-shed request into
+            # HTTP 429/503; partially-shed requests degrade per image there
+            raise
         except httpx.HTTPError as e:
             return DetectionErrorResult(url=url, error=f"HTTP Error: {e}")
         except Exception as e:
             tb_str = traceback.format_exc()
             return DetectionErrorResult(url=url, error=f"Processing Error: {e}\n{tb_str}")
 
-    async def detect(self, payload: dict) -> DetectionResponse:
+    async def detect(
+        self, payload: dict, deadline: Deadline | None = None
+    ) -> DetectionResponse:
         request = DetectionRequest.model_validate(payload)
-        tasks = [self._process_single_image(str(u)) for u in request.image_urls]
-        results = await asyncio.gather(*tasks)
+        if deadline is None:
+            deadline = Deadline.from_env()
+        urls = [str(u) for u in request.image_urls]
+        tasks = [self._process_single_image(u, deadline) for u in urls]
+        gathered = await asyncio.gather(*tasks, return_exceptions=True)
+
+        shed = [r for r in gathered if isinstance(r, AdmissionError)]
+        if shed and len(shed) == len(gathered):
+            raise shed[0]  # whole request shed -> HTTP 429/503 + Retry-After
+
+        results: list[ImageResult] = []
+        for url, r in zip(urls, gathered):
+            if isinstance(r, AdmissionError):
+                results.append(DetectionErrorResult(url=url, error=f"Overloaded: {r}"))
+            elif isinstance(r, BaseException):
+                raise r  # unexpected: _process_single_image contains the rest
+            else:
+                results.append(r)
 
         amenities: set[str] = set()
         for result in results:
@@ -131,7 +209,40 @@ class AmenitiesDetector:
             if amenities
             else "No relevant amenities detected."
         )
-        return DetectionResponse(amenities_description=description, images=list(results))
+        return DetectionResponse(amenities_description=description, images=results)
+
+    def check_admission(self) -> AdmissionError | None:
+        """HTTP-layer fast path: an AdmissionError to answer with (mapped to
+        429/503 + Retry-After) before any fetch work, or None to proceed.
+        Never consumes the breaker's half-open probe slot — a request that
+        could probe must reach `MicroBatcher.submit` to do so."""
+        if self.batcher.draining:
+            self.engine.metrics.record_shed()
+            return DrainingError("server draining")
+        breaker = self.batcher.breaker
+        if breaker.would_reject():
+            self.engine.metrics.record_shed()
+            return CircuitOpenError(
+                "circuit breaker open", retry_after_s=breaker.retry_after_s()
+            )
+        return None
+
+    def health(self) -> dict:
+        """Readiness snapshot for /healthz: not-ready while the breaker is
+        open/probing or a drain is in progress (liveness is /livez)."""
+        breaker = self.batcher.breaker
+        draining = self.batcher.draining
+        ready = breaker.state == CircuitBreaker.CLOSED and not draining
+        return {
+            "status": "ok" if ready else "unready",
+            "ready": ready,
+            "breaker": breaker.state,
+            "draining": draining,
+        }
+
+    async def drain(self) -> dict:
+        """Stop admitting, flush the queue, wait for in-flight batches."""
+        return await self.batcher.drain()
 
     async def aclose(self) -> None:
         await self.batcher.stop()

@@ -1,0 +1,249 @@
+"""Request-lifecycle resilience: deadlines, admission errors, circuit breaking.
+
+The north star is a serving system under heavy traffic (ROADMAP.md), and
+DeepServe's serverless results (PAPERS.md) say the difference between a
+system that degrades and one that collapses is admission control plus fast
+failure detection. This module is the shared vocabulary for that story:
+
+- `Deadline`: a per-request time budget threaded from the HTTP edge through
+  fetch, queue wait, and the device call (`SPOTTER_TPU_REQUEST_DEADLINE_MS`).
+  On expiry the caller gets `DeadlineExceededError` — never an unbounded wait.
+- Admission errors (`QueueFullError`, `CircuitOpenError`, `DrainingError`):
+  raised at `MicroBatcher.submit` time, mapped to HTTP 429/503 with a
+  `Retry-After` hint by the runtime (serving/standalone.py).
+- `CircuitBreaker`: trips after N consecutive batch failures, flips
+  readiness (`/healthz` -> 503) while liveness stays green, and half-opens
+  with a probe request after a cooldown. State transitions are recorded in
+  `engine.metrics` so `/metrics` exposes them.
+
+Everything here is event-loop-thread code except the breaker, which is also
+touched from batch tasks; a lock keeps it safe either way.
+"""
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+DEADLINE_ENV = "SPOTTER_TPU_REQUEST_DEADLINE_MS"
+QUEUE_DEPTH_ENV = "SPOTTER_TPU_QUEUE_DEPTH"
+BATCH_TIMEOUT_ENV = "SPOTTER_TPU_BATCH_TIMEOUT_MS"
+BREAKER_THRESHOLD_ENV = "SPOTTER_TPU_BREAKER_THRESHOLD"
+BREAKER_COOLDOWN_ENV = "SPOTTER_TPU_BREAKER_COOLDOWN_S"
+DRAIN_TIMEOUT_ENV = "SPOTTER_TPU_DRAIN_TIMEOUT_S"
+
+DEFAULT_QUEUE_DEPTH = 64
+DEFAULT_BATCH_TIMEOUT_MS = 120_000.0
+DEFAULT_BREAKER_THRESHOLD = 5
+DEFAULT_BREAKER_COOLDOWN_S = 10.0
+DEFAULT_DRAIN_TIMEOUT_S = 30.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from None
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's time budget ran out (fetch, queue wait, or device call)."""
+
+
+class AdmissionError(RuntimeError):
+    """Base for load-shedding rejections; carries HTTP mapping hints."""
+
+    status = 503
+    retry_after_s = 1.0
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None) -> None:
+        super().__init__(message)
+        if retry_after_s is not None:
+            self.retry_after_s = retry_after_s
+
+
+class QueueFullError(AdmissionError):
+    """Bounded batcher queue is full — shed with 429 (client should retry)."""
+
+    status = 429
+
+
+class CircuitOpenError(AdmissionError):
+    """Circuit breaker is open — the engine is failing; shed with 503."""
+
+    status = 503
+
+
+class DrainingError(AdmissionError):
+    """Server is draining (preStop) or stopped — shed with 503, don't retry here."""
+
+    status = 503
+
+
+@dataclass
+class Deadline:
+    """Monotonic-clock budget. `None` (no deadline) is represented by the
+    absence of a Deadline, not a sentinel — `Deadline.from_env()` returns
+    None when the knob is unset/0 so the no-deadline path costs nothing."""
+
+    expires_at: float
+    budget_s: float
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(expires_at=time.monotonic() + seconds, budget_s=seconds)
+
+    @classmethod
+    def from_env(cls) -> Optional["Deadline"]:
+        ms = _env_float(DEADLINE_ENV, 0.0)
+        return cls.after(ms / 1000.0) if ms > 0 else None
+
+    def remaining(self) -> float:
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def exceeded(self, what: str) -> DeadlineExceededError:
+        return DeadlineExceededError(
+            f"deadline of {self.budget_s * 1000.0:.0f} ms exceeded during {what}"
+        )
+
+    async def wait_for(self, awaitable, what: str):
+        """Bound an awaitable by the remaining budget; DeadlineExceededError
+        on expiry (the awaitable is cancelled)."""
+        import asyncio
+
+        try:
+            return await asyncio.wait_for(awaitable, max(self.remaining(), 0.0))
+        except asyncio.TimeoutError:
+            raise self.exceeded(what) from None
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probing.
+
+    closed -> (threshold consecutive failures) -> open
+    open   -> (cooldown elapsed, next allow() admits ONE probe) -> half_open
+    half_open -> probe success -> closed; probe failure -> open again
+
+    `threshold <= 0` disables the breaker (always closed). Transitions are
+    pushed to `metrics.record_breaker_transition` so /metrics shows them.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(
+        self,
+        threshold: int = DEFAULT_BREAKER_THRESHOLD,
+        cooldown_s: float = DEFAULT_BREAKER_COOLDOWN_S,
+        metrics=None,
+        clock=time.monotonic,
+    ) -> None:
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+
+    @classmethod
+    def from_env(cls, metrics=None) -> "CircuitBreaker":
+        return cls(
+            threshold=_env_int(BREAKER_THRESHOLD_ENV, DEFAULT_BREAKER_THRESHOLD),
+            cooldown_s=_env_float(BREAKER_COOLDOWN_ENV, DEFAULT_BREAKER_COOLDOWN_S),
+            metrics=metrics,
+        )
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition(self, new_state: str) -> None:
+        # caller holds the lock
+        if new_state == self._state:
+            return
+        self._state = new_state
+        if self.metrics is not None:
+            self.metrics.record_breaker_transition(new_state)
+
+    def allow(self) -> bool:
+        """Admission check — consumes the half-open probe slot when it grants
+        one, so exactly one request probes a recovering engine at a time."""
+        if self.threshold <= 0:
+            return True
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at < self.cooldown_s:
+                    return False
+                self._transition(self.HALF_OPEN)
+                self._probe_in_flight = True
+                return True
+            # HALF_OPEN: one probe at a time
+            if self._probe_in_flight:
+                return False
+            self._probe_in_flight = True
+            return True
+
+    def would_reject(self) -> bool:
+        """Non-consuming peek for HTTP pre-checks: True only while OPEN
+        inside the cooldown. A cooldown-elapsed or half-open request must
+        reach `allow()` so probing can happen — this never blocks it."""
+        if self.threshold <= 0:
+            return False
+        with self._lock:
+            return (
+                self._state == self.OPEN
+                and self._clock() - self._opened_at < self.cooldown_s
+            )
+
+    def record_success(self) -> None:
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+            if self._state != self.CLOSED:
+                self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._probe_in_flight = False
+            if self._state == self.HALF_OPEN:
+                self._opened_at = self._clock()
+                self._transition(self.OPEN)
+                return
+            self._consecutive_failures += 1
+            if self._state == self.CLOSED and self._consecutive_failures >= self.threshold:
+                self._opened_at = self._clock()
+                self._transition(self.OPEN)
+
+    def retry_after_s(self) -> float:
+        with self._lock:
+            if self._state != self.OPEN:
+                return 1.0
+            return max(self.cooldown_s - (self._clock() - self._opened_at), 1.0)

@@ -4,6 +4,15 @@ Serves the same route the Ray Serve app exposes behind the manager proxy
 (route_prefix /detect — rayservice-template.yaml:10; proxy target
 handlers.go:298-304), plus /healthz and /metrics (SURVEY.md §5.5 requires
 throughput/latency counters that the reference lacks).
+
+Resilience surface (ISSUE 1): /detect answers 429 (queue full) or 503
+(breaker open / draining) with a Retry-After hint when the request is shed;
+/healthz is READINESS (503 while the breaker is open or a drain is in
+progress) while /livez is LIVENESS (200 whenever the process serves HTTP) —
+the split k8s needs to stop routing without restarting the pod; /drain is
+the preStop hook: stop admitting, flush the queue, wait for in-flight
+batches. SPOTTER_TPU_FAULTS arms the fault-injection harness
+(spotter_tpu/testing/faults.py) for chaos staging — loud at startup.
 """
 
 import argparse
@@ -18,6 +27,8 @@ from aiohttp import web
 
 from spotter_tpu.engine import profiler
 from spotter_tpu.serving.app import build_detector_app
+from spotter_tpu.serving.resilience import AdmissionError
+from spotter_tpu.testing import faults
 
 logger = logging.getLogger(__name__)
 
@@ -30,12 +41,29 @@ def _rmdir_quiet(path: str) -> None:
         pass
 
 
+def _shed_response(exc: AdmissionError) -> web.Response:
+    return web.json_response(
+        {"error": str(exc), "status": exc.status},
+        status=exc.status,
+        headers={"Retry-After": f"{max(exc.retry_after_s, 0.0):.0f}"},
+    )
+
+
 def make_app(detector=None, model_name: str | None = None, warmup: bool = False) -> web.Application:
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["detector"] = detector or build_detector_app(model_name, warmup=warmup)
     profiler.maybe_start_profiler_server()
+    if faults.maybe_activate_from_env() is not None:
+        logger.warning(
+            "FAULT INJECTION ACTIVE (%s) — this server is a chaos target, "
+            "never production",
+            faults.FAULTS_ENV,
+        )
 
     async def detect(request: web.Request) -> web.Response:
+        shed = request.app["detector"].check_admission()
+        if shed is not None:  # draining / breaker open: reject before fetching
+            return _shed_response(shed)
         try:
             payload = await request.json()
         except json.JSONDecodeError:
@@ -44,13 +72,29 @@ def make_app(detector=None, model_name: str | None = None, warmup: bool = False)
             response = await request.app["detector"].detect(payload)
         except pydantic.ValidationError as exc:
             return web.Response(status=400, text=f"Invalid request: {exc}")
+        except AdmissionError as exc:  # every image shed -> 429/503
+            return _shed_response(exc)
         except Exception:
             logger.exception("detect failed")
             return web.Response(status=500, text="Internal server error")
         return web.json_response(response.model_dump())
 
     async def healthz(request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        """Readiness: 503 drops this replica from the LB while the breaker
+        is open or a drain is in progress; recovery (successful half-open
+        probe) flips it back to 200."""
+        health = request.app["detector"].health()
+        return web.json_response(health, status=200 if health["ready"] else 503)
+
+    async def livez(request: web.Request) -> web.Response:
+        """Liveness: the process is serving HTTP — restart only on hang."""
+        return web.json_response({"status": "alive"})
+
+    async def drain(request: web.Request) -> web.Response:
+        """k8s preStop: stop admitting, flush the queue, wait for in-flight
+        batches. Idempotent — a second call reports the drained state."""
+        summary = await request.app["detector"].drain()
+        return web.json_response(summary)
 
     async def metrics(request: web.Request) -> web.Response:
         return web.json_response(request.app["detector"].engine.metrics.snapshot())
@@ -94,6 +138,8 @@ def make_app(detector=None, model_name: str | None = None, warmup: bool = False)
 
     app.router.add_post("/detect", detect)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/livez", livez)
+    app.router.add_post("/drain", drain)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/profile", profile)
     app.on_cleanup.append(on_cleanup)

@@ -1,0 +1,1 @@
+"""Test-support package: fault injection for the serving path (faults.py)."""

@@ -1,0 +1,134 @@
+"""Fault-injection harness for the serving path (ISSUE 1 chaos suite).
+
+Spotlight's spot-instance orientation (PAPER.md) makes "the engine just
+died / hung / returned garbage" a first-class scenario, not an edge case.
+This module lets tests (and staging deployments) inject those faults at the
+two seams where the real failures happen, without monkeypatching internals:
+
+- `detector._fetch_image_bytes` calls `await on_fetch(url)` — may raise a
+  connection error, sleep (slow CDN), or substitute malformed bytes;
+- the MicroBatcher's worker thread calls `on_engine_batch(n)` right before
+  `engine.detect` — may raise (XLA error, preempted device) or hang
+  (wedged device call; the watchdog's reason to exist).
+
+Activation is explicit: either the `inject(...)` context manager (tests) or
+`maybe_activate_from_env()` reading `SPOTTER_TPU_FAULTS` (e.g.
+`"fetch_error=2,engine_hang_s=30"`) for a chaos-staging server. When no
+plan is active every hook is a single global None check — zero cost on the
+production path.
+
+Counters (`fetch_error=N`, `engine_error=N`, `malformed_image=N`) arm the
+next N occurrences; `-1` means "every one". Durations (`fetch_delay_s`,
+`engine_hang_s`) apply to every call while the plan is active; a hang waits
+on `plan.release` so a test can un-wedge the engine deterministically.
+"""
+
+import asyncio
+import contextlib
+import os
+import threading
+from dataclasses import dataclass, field
+
+FAULTS_ENV = "SPOTTER_TPU_FAULTS"
+
+MALFORMED_BYTES = b"\x00\x01not-an-image\xff"
+
+
+@dataclass
+class FaultPlan:
+    fetch_error: int = 0
+    fetch_delay_s: float = 0.0
+    malformed_image: int = 0
+    engine_error: int = 0
+    engine_hang_s: float = 0.0
+    # set() to un-wedge hanging engine calls early (tests)
+    release: threading.Event = field(default_factory=threading.Event)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _consume(self, attr: str) -> bool:
+        with self._lock:
+            n = getattr(self, attr)
+            if n == 0:
+                return False
+            if n > 0:
+                setattr(self, attr, n - 1)
+            return True
+
+
+_active: FaultPlan | None = None
+
+
+def active() -> FaultPlan | None:
+    return _active
+
+
+@contextlib.contextmanager
+def inject(**kwargs):
+    """Activate a fault plan for the enclosed block (re-entrant: restores
+    whatever plan was active before)."""
+    global _active
+    prev = _active
+    plan = FaultPlan(**kwargs)
+    _active = plan
+    try:
+        yield plan
+    finally:
+        _active = prev
+
+
+def maybe_activate_from_env() -> FaultPlan | None:
+    """Arm a process-wide plan from SPOTTER_TPU_FAULTS (chaos staging only —
+    the standalone server calls this at startup and logs loudly)."""
+    global _active
+    spec = os.environ.get(FAULTS_ENV, "").strip()
+    if not spec:
+        return None
+    kwargs: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        key = key.strip()
+        if key not in (
+            "fetch_error",
+            "fetch_delay_s",
+            "malformed_image",
+            "engine_error",
+            "engine_hang_s",
+        ):
+            raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
+        try:
+            kwargs[key] = float(value) if key.endswith("_s") else int(value)
+        except ValueError:
+            raise ValueError(f"bad {FAULTS_ENV} entry {part!r}") from None
+    _active = FaultPlan(**kwargs)
+    return _active
+
+
+async def on_fetch(url: str) -> bytes | None:
+    """Detector fetch hook: returns substitute bytes, raises, sleeps, or
+    (the usual case) returns None meaning "fetch normally"."""
+    plan = _active
+    if plan is None:
+        return None
+    if plan.fetch_delay_s > 0:
+        await asyncio.sleep(plan.fetch_delay_s)
+    if plan._consume("fetch_error"):
+        import httpx
+
+        raise httpx.ConnectError(f"injected fetch failure for {url}")
+    if plan._consume("malformed_image"):
+        return MALFORMED_BYTES
+    return None
+
+
+def on_engine_batch(n_images: int) -> None:
+    """Batcher worker-thread hook, called just before engine.detect."""
+    plan = _active
+    if plan is None:
+        return
+    if plan.engine_hang_s > 0:
+        plan.release.wait(plan.engine_hang_s)
+    if plan._consume("engine_error"):
+        raise RuntimeError(f"injected engine failure (batch of {n_images})")

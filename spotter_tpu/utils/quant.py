@@ -11,10 +11,14 @@ asm-level evidence (tools/bench_int8.py, v5e session 2026-07-31):
   (283.6 TOP/s vs 168.0 TFLOP/s, 1.69x); conv shapes measured separately in
   tools/bench_int8_conv.py.
 
-Scheme: dynamic symmetric per-tensor activation scales + per-out-channel
-weight scales, int32 accumulation, dequant folded into the frozen-BN
+Scheme: dynamic symmetric per-SAMPLE activation scales (one scale per batch
+row — NOT per-tensor: a batch-wide max would couple each image's quantization
+grid to its batch neighbors, breaking bit-determinism under the
+MicroBatcher's traffic-dependent batch shapes; see
+test_quantize_activation_per_sample_scale) + per-out-channel weight scales,
+int32 accumulation, dequant folded into the frozen-BN
 multiply that already follows every conv (models/layers.py ConvNorm). No
-calibration state: the activation scale is max|x|/127 computed per call —
+calibration state: the activation scale is max|x|/127 computed per sample —
 XLA fuses the reduce into the producing elementwise chain, and the int8
 cast HALVES the conv's activation-read traffic, so the quantize pass is
 nearly free on the compute-bound 3x3 convs it targets.

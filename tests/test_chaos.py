@@ -1,0 +1,357 @@
+"""Chaos suite (ISSUE 1 acceptance): under injected fetch failures, engine
+exceptions, and a simulated engine hang, no request awaits forever — every
+caller gets a result, a structured error, or a shed response within its
+deadline, and the pump keeps serving subsequent traffic. Faults come from
+spotter_tpu/testing/faults.py, the same harness a chaos-staging server arms
+via SPOTTER_TPU_FAULTS."""
+
+import asyncio
+import time
+from io import BytesIO
+from unittest.mock import AsyncMock
+
+import httpx
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from PIL import Image
+
+from spotter_tpu.engine.batcher import BatchTimeoutError, MicroBatcher
+from spotter_tpu.engine.metrics import Metrics
+from spotter_tpu.schemas import DetectionErrorResult, DetectionSuccessResult
+from spotter_tpu.serving.detector import AmenitiesDetector
+from spotter_tpu.serving.resilience import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+)
+from spotter_tpu.serving.standalone import make_app
+from spotter_tpu.testing import faults
+
+DETS = [{"label": "tv", "score": 0.9, "box": [1.0, 2.0, 20.0, 30.0]}]
+
+
+class FakeEngine:
+    def __init__(self, detections=DETS):
+        self.detections = detections
+        self.metrics = Metrics()
+        self.batch_buckets = (1, 2, 4)
+        self.calls = []
+        self.broken = False
+
+    def detect(self, images):
+        if self.broken:
+            raise RuntimeError("engine down")
+        self.calls.append(len(images))
+        return [list(self.detections) for _ in images]
+
+
+@pytest.fixture(autouse=True)
+def _zero_retry_backoff(monkeypatch):
+    import spotter_tpu.serving.detector as det_mod
+
+    monkeypatch.setattr(det_mod, "FETCH_RETRY_WAIT_MIN_S", 0.0)
+    monkeypatch.setattr(det_mod, "FETCH_RETRY_WAIT_MAX_S", 0.0)
+
+
+def _image_bytes(w=32, h=32):
+    img = Image.fromarray(np.full((h, w, 3), 128, np.uint8))
+    buf = BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _client_returning_image():
+    resp = AsyncMock()
+    resp.content = _image_bytes()
+    resp.raise_for_status = lambda: None
+    client = AsyncMock(spec=httpx.AsyncClient)
+    client.get.return_value = resp
+    return client
+
+
+def _img():
+    return Image.fromarray(np.zeros((8, 8, 3), np.uint8))
+
+
+def _detector(engine=None, **batcher_kwargs):
+    engine = engine or FakeEngine()
+    batcher_kwargs.setdefault("max_delay_ms", 1.0)
+    batcher_kwargs.setdefault(
+        "breaker", CircuitBreaker(threshold=100, metrics=engine.metrics)
+    )
+    batcher = MicroBatcher(engine, **batcher_kwargs)
+    return AmenitiesDetector(engine, batcher, _client_returning_image()), engine
+
+
+def test_fetch_faults_contained_and_pump_survives():
+    detector, engine = _detector()
+
+    async def run():
+        with faults.inject(fetch_error=-1):
+            broken = await detector.detect(
+                {"image_urls": ["http://e.com/a.jpg", "http://e.com/b.jpg"]}
+            )
+        healthy = await detector.detect({"image_urls": ["http://e.com/c.jpg"]})
+        await detector.batcher.stop()
+        return broken, healthy
+
+    broken, healthy = asyncio.run(run())
+    assert all(isinstance(r, DetectionErrorResult) for r in broken.images)
+    assert all(r.error.startswith("HTTP Error:") for r in broken.images)
+    (ok,) = healthy.images
+    assert isinstance(ok, DetectionSuccessResult)
+
+
+def test_malformed_image_contained():
+    detector, _ = _detector()
+
+    async def run():
+        with faults.inject(malformed_image=1):
+            broken = await detector.detect({"image_urls": ["http://e.com/a.jpg"]})
+        healthy = await detector.detect({"image_urls": ["http://e.com/b.jpg"]})
+        await detector.batcher.stop()
+        return broken, healthy
+
+    broken, healthy = asyncio.run(run())
+    (bad,) = broken.images
+    assert isinstance(bad, DetectionErrorResult)
+    assert bad.error.startswith("Processing Error:")
+    assert isinstance(healthy.images[0], DetectionSuccessResult)
+
+
+def test_engine_exception_fails_only_its_batch():
+    detector, engine = _detector()
+
+    async def run():
+        with faults.inject(engine_error=1):
+            broken = await detector.detect({"image_urls": ["http://e.com/a.jpg"]})
+        healthy = await detector.detect({"image_urls": ["http://e.com/b.jpg"]})
+        await detector.batcher.stop()
+        return broken, healthy
+
+    broken, healthy = asyncio.run(run())
+    (bad,) = broken.images
+    assert isinstance(bad, DetectionErrorResult)
+    assert "injected engine failure" in bad.error
+    assert isinstance(healthy.images[0], DetectionSuccessResult)
+    assert engine.metrics.snapshot()["errors_total"] >= 1
+
+
+def test_engine_hang_watchdog_frees_slot_and_pump_survives():
+    """A wedged engine call must fail its futures via the watchdog and
+    release its in-flight slot — not deadlock the pump forever."""
+    engine = FakeEngine()
+    batcher = MicroBatcher(
+        engine,
+        max_batch=1,
+        max_delay_ms=1.0,
+        max_in_flight=1,
+        batch_timeout_ms=200.0,
+        breaker=CircuitBreaker(threshold=100, metrics=engine.metrics),
+    )
+
+    async def run():
+        t0 = time.monotonic()
+        with faults.inject(engine_hang_s=30.0) as plan:
+            with pytest.raises(BatchTimeoutError):
+                await batcher.submit(_img(), deadline=Deadline.after(5.0))
+            hung_for = time.monotonic() - t0
+            plan.release.set()  # un-wedge the orphaned worker thread
+        result = await batcher.submit(_img())
+        await batcher.stop()
+        return hung_for, result
+
+    hung_for, result = asyncio.run(run())
+    assert hung_for < 2.0  # watchdog (200 ms), not the 30 s hang or 5 s deadline
+    assert result == DETS
+    snap = engine.metrics.snapshot()
+    assert snap["batch_timeouts_total"] == 1
+
+
+def test_deadline_bounds_slow_fetch():
+    detector, _ = _detector()
+
+    async def run():
+        t0 = time.monotonic()
+        with faults.inject(fetch_delay_s=5.0):
+            resp = await detector.detect(
+                {"image_urls": ["http://e.com/a.jpg"]},
+                deadline=Deadline.after(0.15),
+            )
+        elapsed = time.monotonic() - t0
+        await detector.batcher.stop()
+        return resp, elapsed
+
+    resp, elapsed = asyncio.run(run())
+    (r,) = resp.images
+    assert isinstance(r, DetectionErrorResult)
+    assert r.error.startswith("Deadline exceeded:")
+    assert elapsed < 1.0  # bounded by the deadline, not the injected delay
+
+
+def test_deadline_bounds_hung_device_call():
+    engine = FakeEngine()
+    batcher = MicroBatcher(
+        engine,
+        max_batch=1,
+        max_delay_ms=1.0,
+        batch_timeout_ms=0.0,  # watchdog off: the deadline alone must bound it
+        breaker=CircuitBreaker(threshold=100, metrics=engine.metrics),
+    )
+
+    async def run():
+        with faults.inject(engine_hang_s=10.0) as plan:
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError):
+                await batcher.submit(_img(), deadline=Deadline.after(0.15))
+            elapsed = time.monotonic() - t0
+            plan.release.set()
+        await batcher.stop()
+        return elapsed
+
+    elapsed = asyncio.run(run())
+    assert elapsed < 1.0
+    assert engine.metrics.snapshot()["deadline_exceeded_total"] == 1
+
+
+def test_server_breaker_healthz_cycle():
+    """Acceptance: /healthz 503 while the breaker is open, 200 again after a
+    successful half-open probe, transitions visible in /metrics."""
+    engine = FakeEngine()
+    engine.broken = True
+    # cooldown long enough that the shed-while-open assertions can't race it;
+    # the test elapses it deterministically by rewinding _opened_at
+    breaker = CircuitBreaker(threshold=2, cooldown_s=60.0, metrics=engine.metrics)
+    batcher = MicroBatcher(engine, max_delay_ms=1.0, breaker=breaker)
+    detector = AmenitiesDetector(engine, batcher, _client_returning_image())
+
+    async def run():
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            payload = {"image_urls": ["http://e.com/a.jpg"]}
+            # two engine-failure batches: contained per-image (HTTP 200) but
+            # counted by the breaker, which trips at threshold 2
+            for _ in range(2):
+                resp = await client.post("/detect", json=payload)
+                assert resp.status == 200
+                body = await resp.json()
+                assert "Processing Error" in body["images"][0]["error"]
+            assert breaker.state == CircuitBreaker.OPEN
+
+            health = await client.get("/healthz")
+            assert health.status == 503
+            assert (await health.json())["breaker"] == "open"
+            live = await client.get("/livez")
+            assert live.status == 200  # liveness is separate from readiness
+
+            shed = await client.post("/detect", json=payload)
+            assert shed.status == 503
+            assert "Retry-After" in shed.headers
+
+            metrics = await (await client.get("/metrics")).json()
+            assert metrics["breaker_state"] == "open"
+            assert metrics["breaker_transitions_total"] >= 1
+            assert metrics["shed_total"] >= 1
+
+            # fix the engine and elapse the cooldown; the next request is the
+            # half-open probe — success closes the breaker
+            engine.broken = False
+            breaker._opened_at -= 61.0
+            probe = await client.post("/detect", json=payload)
+            assert probe.status == 200
+            assert isinstance((await probe.json())["images"][0].get("detections"), list)
+
+            health = await client.get("/healthz")
+            assert health.status == 200
+            metrics = await (await client.get("/metrics")).json()
+            assert metrics["breaker_state"] == "closed"
+
+    asyncio.run(run())
+
+
+def test_server_queue_full_sheds_429():
+    """Overload at the HTTP edge: with the engine wedged and the queue full,
+    a fully-shed request answers 429 + Retry-After instead of buffering."""
+    engine = FakeEngine()
+    batcher = MicroBatcher(
+        engine,
+        max_batch=1,
+        max_delay_ms=1.0,
+        max_in_flight=1,
+        max_queue=1,
+        batch_timeout_ms=0.0,
+        breaker=CircuitBreaker(threshold=100, metrics=engine.metrics),
+    )
+    detector = AmenitiesDetector(engine, batcher, _client_returning_image())
+
+    async def run():
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            payload = {"image_urls": ["http://e.com/a.jpg"]}
+            with faults.inject(engine_hang_s=10.0) as plan:
+                first = asyncio.create_task(client.post("/detect", json=payload))
+                await asyncio.sleep(0.1)  # r1 now wedged in the engine
+                second = asyncio.create_task(client.post("/detect", json=payload))
+                await asyncio.sleep(0.1)  # r2 drained, held by the pump at the slot
+                third = asyncio.create_task(client.post("/detect", json=payload))
+                await asyncio.sleep(0.1)  # r3 occupies the queue (depth 1)
+                fourth = await client.post("/detect", json=payload)
+                assert fourth.status == 429
+                assert "Retry-After" in fourth.headers
+                plan.release.set()
+                r1, r2, r3 = await asyncio.gather(first, second, third)
+                assert {r1.status, r2.status, r3.status} == {200}
+            snap = engine.metrics.snapshot()
+            assert snap["shed_total"] >= 1
+
+    asyncio.run(run())
+
+
+def test_server_drain_hook():
+    """/drain (k8s preStop): flush, then stop admitting with 503; readiness
+    goes unready while liveness stays green."""
+    detector, _ = _detector()
+
+    async def run():
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            payload = {"image_urls": ["http://e.com/a.jpg"]}
+            ok = await client.post("/detect", json=payload)
+            assert ok.status == 200
+
+            drained = await client.post("/drain")
+            assert drained.status == 200
+            body = await drained.json()
+            assert body["status"] == "drained"
+            assert body["queued_failed"] == 0
+
+            shed = await client.post("/detect", json=payload)
+            assert shed.status == 503
+            health = await client.get("/healthz")
+            assert health.status == 503
+            assert (await health.json())["draining"] is True
+            live = await client.get("/livez")
+            assert live.status == 200
+
+            metrics = await (await client.get("/metrics")).json()
+            assert metrics["draining"] is True
+
+            again = await client.post("/drain")  # idempotent
+            assert again.status == 200
+
+    asyncio.run(run())
+
+
+def test_faults_env_activation(monkeypatch):
+    monkeypatch.setenv(faults.FAULTS_ENV, "fetch_error=2,engine_hang_s=1.5")
+    plan = faults.maybe_activate_from_env()
+    try:
+        assert plan.fetch_error == 2
+        assert plan.engine_hang_s == 1.5
+        assert faults.active() is plan
+    finally:
+        faults._active = None
+    monkeypatch.setenv(faults.FAULTS_ENV, "bogus_fault=1")
+    with pytest.raises(ValueError):
+        faults.maybe_activate_from_env()

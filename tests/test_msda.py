@@ -383,6 +383,10 @@ def test_sg_nest_knob_validation():
         {"SPOTTER_TPU_MSDA_SG": "8", "SPOTTER_TPU_MSDA_PREP": "kernel"},
         {"SPOTTER_TPU_MSDA_NEST": "1", "SPOTTER_TPU_MSDA_PREP": "kernel"},
         {"SPOTTER_TPU_MSDA_SG": "12"},
+        # ADVICE r5 #3: knobs + `auto` on a CPU host (auto -> xla) must fail
+        # fast at import, not abort every forward at call time
+        {"SPOTTER_TPU_MSDA_SG": "8"},
+        {"SPOTTER_TPU_MSDA_NEST": "1"},
     ):
         proc = subprocess.run(
             [sys.executable, "-c", "import spotter_tpu.ops.msda"],

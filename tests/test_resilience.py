@@ -1,0 +1,131 @@
+"""Unit tests for the resilience primitives (serving/resilience.py):
+Deadline budgets, CircuitBreaker state machine (fake clock), env parsing."""
+
+import pytest
+
+from spotter_tpu.engine.metrics import Metrics
+from spotter_tpu.serving.resilience import (
+    BREAKER_COOLDOWN_ENV,
+    BREAKER_THRESHOLD_ENV,
+    DEADLINE_ENV,
+    CircuitBreaker,
+    Deadline,
+)
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def test_deadline_from_env_unset_is_none(monkeypatch):
+    monkeypatch.delenv(DEADLINE_ENV, raising=False)
+    assert Deadline.from_env() is None
+    monkeypatch.setenv(DEADLINE_ENV, "0")
+    assert Deadline.from_env() is None
+
+
+def test_deadline_from_env_budget(monkeypatch):
+    monkeypatch.setenv(DEADLINE_ENV, "250")
+    dl = Deadline.from_env()
+    assert dl is not None
+    assert dl.budget_s == pytest.approx(0.25)
+    assert not dl.expired()
+    assert 0.0 < dl.remaining() <= 0.25
+
+
+def test_deadline_expiry():
+    dl = Deadline.after(-0.001)  # already past
+    assert dl.expired()
+    err = dl.exceeded("unit test")
+    assert isinstance(err, TimeoutError)
+    assert "unit test" in str(err)
+
+
+def test_breaker_trips_after_threshold_and_half_opens():
+    clock = FakeClock()
+    metrics = Metrics()
+    br = CircuitBreaker(threshold=3, cooldown_s=5.0, metrics=metrics, clock=clock)
+    assert br.state == CircuitBreaker.CLOSED
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    assert br.would_reject()
+
+    # cooldown not elapsed: still shedding
+    clock.now += 4.0
+    assert not br.allow()
+
+    # cooldown elapsed: exactly one probe admitted
+    clock.now += 2.0
+    assert not br.would_reject()  # pre-check must not block the probe
+    assert br.allow()
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert not br.allow()  # second concurrent request is shed while probing
+
+    # probe success closes; traffic flows again
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow()
+
+    snap = metrics.snapshot()
+    assert snap["breaker_state"] == "closed"
+    # closed -> open -> half_open -> closed
+    assert snap["breaker_transitions_total"] == 3
+
+
+def test_breaker_failed_probe_reopens():
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    clock.now += 6.0
+    assert br.allow()  # probe
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()  # cooldown restarted
+    clock.now += 6.0
+    assert br.allow()
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(threshold=3, cooldown_s=5.0)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED  # never 3 consecutive
+
+
+def test_breaker_disabled_never_trips():
+    br = CircuitBreaker(threshold=0)
+    for _ in range(50):
+        br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow() and not br.would_reject()
+
+
+def test_breaker_from_env(monkeypatch):
+    monkeypatch.setenv(BREAKER_THRESHOLD_ENV, "7")
+    monkeypatch.setenv(BREAKER_COOLDOWN_ENV, "2.5")
+    br = CircuitBreaker.from_env()
+    assert br.threshold == 7
+    assert br.cooldown_s == 2.5
+
+
+def test_breaker_retry_after_tracks_cooldown():
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=1, cooldown_s=10.0, clock=clock)
+    br.record_failure()
+    assert br.retry_after_s() == pytest.approx(10.0)
+    clock.now += 6.0
+    assert br.retry_after_s() == pytest.approx(4.0)

@@ -44,7 +44,13 @@ def test_detect_healthz_metrics_round_trip():
         async with TestClient(TestServer(app)) as client:
             health = await client.get("/healthz")
             assert health.status == 200
-            assert (await health.json()) == {"status": "ok"}
+            body = await health.json()
+            assert body["status"] == "ok"
+            assert body["breaker"] == "closed" and body["draining"] is False
+
+            live = await client.get("/livez")
+            assert live.status == 200
+            assert (await live.json()) == {"status": "alive"}
 
             resp = await client.post(
                 "/detect", json={"image_urls": ["http://example.com/room.jpg"]}
