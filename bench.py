@@ -213,6 +213,181 @@ def overload_bench(args) -> int:
     return 0
 
 
+def failover_bench(args) -> int:
+    """Failover behavior, measured not asserted (ISSUE 2): two REAL
+    supervised replica processes (stub engine — the quantity under test is
+    the lifecycle/failover machinery, not the forward pass; CPU ok) behind
+    the ReplicaPool under concurrent load. Mid-run, a preemption fault (the
+    maintenance-event file) takes one replica through the real sequence:
+    drain -> distinct preemption exit -> supervisor restart -> ready.
+
+    Prints ONE JSON line: client-visible error rate, p99 of requests
+    completing inside the drain/outage window, and time-to-ready of the
+    preempted replica (fault -> /startupz 200 again).
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from spotter_tpu.serving.replica_pool import ReplicaPool
+    from spotter_tpu.testing import cluster
+
+    n_requests = args.failover_requests
+    concurrency = args.failover_concurrency
+    replica_env = {"SPOTTER_TPU_STUB_SERVICE_MS": str(args.failover_service_ms)}
+
+    with tempfile.TemporaryDirectory() as workdir:
+        marker = os.path.join(workdir, "preempt-victim")
+        ports = cluster.pick_ports(2)
+        victim = cluster.SupervisedReplica(
+            ports[0],
+            os.path.join(workdir, "victim.pid"),
+            env={
+                **replica_env,
+                "SPOTTER_TPU_PREEMPTION_FILE": marker,
+                "SPOTTER_TPU_PREEMPTION_POLL_S": "0.05",
+            },
+        )
+        survivor = cluster.SupervisedReplica(
+            ports[1], os.path.join(workdir, "survivor.pid"), env=replica_env
+        )
+        try:
+            for r in (victim, survivor):
+                cluster.wait_ready(r.url)
+
+            samples: list[tuple[float, float]] = []  # (completed_at, latency_s)
+            failures = 0
+            timeline = {"fault_at": None, "ready_at": None}
+
+            async def drive() -> None:
+                nonlocal failures
+                import httpx
+
+                pool = ReplicaPool(
+                    [victim.url, survivor.url],
+                    eject_threshold=1,
+                    backoff_base_s=0.2,
+                    health_interval_s=0.1,
+                    request_timeout_s=10.0,
+                )
+                await pool.start()
+                payload = {"image_urls": ["http://example.com/room.jpg"]}
+                fault_after = n_requests // 3
+                done = {"n": 0}
+
+                async def one() -> None:
+                    nonlocal failures
+                    t0 = time.perf_counter()
+                    try:
+                        await pool.detect(payload)
+                        samples.append((time.monotonic(), time.perf_counter() - t0))
+                    except Exception:
+                        failures += 1
+                    done["n"] += 1
+
+                async def worker() -> None:
+                    # paced issuance: each worker pulls the next request, so
+                    # the fault lands mid-stream, not before the first batch
+                    while done["n"] < n_requests:
+                        await one()
+
+                async def inject_fault() -> None:
+                    while done["n"] < fault_after:
+                        await asyncio.sleep(0.01)
+                    with open(marker, "w") as f:
+                        f.write("preempt")
+                    timeline["fault_at"] = time.monotonic()
+
+                async def watch_recovery() -> None:
+                    # fault -> victim dies (maintenance file consumed: delete
+                    # it once the outage is observed, or the restarted child
+                    # would re-preempt itself forever) -> supervisor restart
+                    # -> /startupz 200 again
+                    while timeline["fault_at"] is None:
+                        await asyncio.sleep(0.02)
+                    async with httpx.AsyncClient() as client:
+                        seen_down = False
+                        while timeline["ready_at"] is None:
+                            try:
+                                resp = await client.get(
+                                    f"{victim.url}/startupz", timeout=1.0
+                                )
+                                down = resp.status_code != 200
+                            except Exception:
+                                down = True
+                            if down and not seen_down:
+                                seen_down = True
+                                try:
+                                    os.unlink(marker)
+                                except OSError:
+                                    pass
+                            elif not down and seen_down:
+                                timeline["ready_at"] = time.monotonic()
+                            await asyncio.sleep(0.05)
+
+                watcher = asyncio.create_task(watch_recovery())
+                await asyncio.gather(
+                    inject_fault(), *(worker() for _ in range(concurrency))
+                )
+                # keep a trickle of load flowing until recovery is observed
+                deadline = time.monotonic() + 60.0
+                while timeline["ready_at"] is None and time.monotonic() < deadline:
+                    await one()
+                    await asyncio.sleep(0.02)
+                watcher.cancel()
+                await pool.stop()
+
+            asyncio.run(drive())
+        finally:
+            victim.shutdown()
+            survivor.shutdown()
+
+    total = len(samples) + failures
+    error_rate = failures / total if total else 1.0
+    t_fault, t_ready = timeline["fault_at"], timeline["ready_at"]
+    time_to_ready_s = (t_ready - t_fault) if (t_fault and t_ready) else None
+    window_end = t_ready if t_ready is not None else time.monotonic()
+    window = [
+        lat for (done_at, lat) in samples
+        if t_fault is not None and t_fault <= done_at <= window_end
+    ]
+    window_p99_ms = (
+        float(np.percentile(window, 99)) * 1e3 if window else None
+    )
+    steady = [lat for (done_at, lat) in samples if t_fault and done_at < t_fault]
+    steady_p50_ms = float(np.median(steady)) * 1e3 if steady else None
+    print(
+        f"# failover: {total} requests, {failures} client-visible failures "
+        f"({error_rate:.3f}); drain/outage window p99 "
+        f"{_fmt(window_p99_ms, '.1f')} ms over {len(window)} requests "
+        f"(steady p50 {_fmt(steady_p50_ms, '.1f')} ms); victim time-to-ready "
+        f"{_fmt(time_to_ready_s, '.2f')} s after preemption fault",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"failover error rate (2 stub replicas, kill-one preemption; "
+            f"window p99 {_fmt(window_p99_ms, '.1f')} ms, time-to-ready "
+            f"{_fmt(time_to_ready_s, '.2f')} s)"
+        ),
+        "value": round(error_rate, 4),
+        "unit": "error_rate",
+        "vs_baseline": None,
+        "requests_total": total,
+        "failures": failures,
+        "drain_window_p99_ms": (
+            None if window_p99_ms is None else round(window_p99_ms, 2)
+        ),
+        "drain_window_requests": len(window),
+        "steady_p50_ms": None if steady_p50_ms is None else round(steady_p50_ms, 2),
+        "time_to_ready_s": (
+            None if time_to_ready_s is None else round(time_to_ready_s, 3)
+        ),
+    }
+    print(json.dumps(result))
+    return 0 if error_rate == 0.0 and time_to_ready_s is not None else 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="rtdetr_v2_r101vd")
@@ -259,10 +434,23 @@ def main() -> int:
     parser.add_argument("--overload-service-ms", type=float, default=20.0)
     parser.add_argument("--overload-delay-ms", type=float, default=2.0)
     parser.add_argument("--overload-deadline-ms", type=float, default=250.0)
+    parser.add_argument(
+        "--failover",
+        action="store_true",
+        help="run the multi-replica failover bench instead (CPU ok, "
+        "model-free): 2 supervised stub replicas behind the pool, one "
+        "preempted mid-load; reports error rate, drain-window p99, "
+        "time-to-ready",
+    )
+    parser.add_argument("--failover-requests", type=int, default=200)
+    parser.add_argument("--failover-concurrency", type=int, default=8)
+    parser.add_argument("--failover-service-ms", type=float, default=5.0)
     args = parser.parse_args()
 
     if args.overload:
         return overload_bench(args)
+    if args.failover:
+        return failover_bench(args)
 
     import os
 

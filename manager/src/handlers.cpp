@@ -350,6 +350,21 @@ void RegisterRoutes(HttpServer* server, const ManagerOptions& opts,
   server->Route("*", "/detect", [opts](const HttpRequest& r) {
     return HandleDetectProxy(opts, r);
   });
+  // Probe endpoints (ISSUE 2): the manager deployment wires its k8s
+  // readiness/liveness probes here. The manager is stateless — serving HTTP
+  // at all IS both ready and alive, so the two return the same 200; they
+  // stay separate routes so the distinction survives if readiness ever
+  // grows a dependency (e.g. apiserver reachability).
+  server->Route("GET", "/healthz", [](const HttpRequest&) {
+    HttpResponse resp;
+    resp.body = "ok\n";
+    return resp;
+  });
+  server->Route("GET", "/livez", [](const HttpRequest&) {
+    HttpResponse resp;
+    resp.body = "ok\n";
+    return resp;
+  });
 }
 
 }  // namespace spotter

@@ -1,5 +1,7 @@
 #include "k8s.h"
 
+#include <unistd.h>
+
 #include <cstdlib>
 #include <fstream>
 #include <sstream>
@@ -25,6 +27,14 @@ std::string ReadFileOrEmpty(const std::string& path) {
 }  // namespace
 
 bool LoadK8sConfig(K8sConfig* cfg, std::string* error) {
+  // Request timeout knob shared by both config paths (ISSUE 2): without a
+  // bound, a wedged apiserver conversation parks a handler thread for the
+  // peer's lifetime.
+  const char* timeout = std::getenv("SPOTTER_K8S_TIMEOUT_S");
+  if (timeout && *timeout) {
+    int t = atoi(timeout);
+    if (t > 0) cfg->timeout_s = t;
+  }
   const char* override_base = std::getenv("SPOTTER_K8S_BASE");
   if (override_base && *override_base) {
     cfg->base_url = override_base;
@@ -67,6 +77,23 @@ std::string K8sClient::BearerToken() {
   return cfg_.token;
 }
 
+ClientResult K8sClient::DoWithRetry(
+    const std::string& method, const std::string& url,
+    const std::map<std::string, std::string>& headers,
+    const std::string& body) {
+  ClientResult result = HttpDo(method, url, headers, body, cfg_.timeout_s,
+                               cfg_.ca_file, cfg_.insecure);
+  // Retry transport failures (connect refused/reset while the apiserver
+  // endpoint fails over) and 5xx (transient server-side errors). 4xx is the
+  // caller's bug — never retried. Both verbs used here are idempotent
+  // (server-side apply PATCH and DELETE), so one replay is safe.
+  bool transient = !result.ok || result.status >= 500;
+  if (!transient) return result;
+  usleep(static_cast<useconds_t>(cfg_.retry_backoff_ms) * 1000);
+  return HttpDo(method, url, headers, body, cfg_.timeout_s, cfg_.ca_file,
+                cfg_.insecure);
+}
+
 ClientResult K8sClient::ApplyRayService(const std::string& ns,
                                         const std::string& name,
                                         const std::string& manifest_yaml) {
@@ -80,8 +107,7 @@ ClientResult K8sClient::ApplyRayService(const std::string& ns,
   // (handlers.go:168-172)
   std::string url = cfg_.base_url + RayServicePath(ns, name) +
                     "?fieldManager=spotter-manager&force=true";
-  return HttpDo("PATCH", url, headers, manifest_yaml, 30, cfg_.ca_file,
-                cfg_.insecure);
+  return DoWithRetry("PATCH", url, headers, manifest_yaml);
 }
 
 ClientResult K8sClient::DeleteRayService(const std::string& ns,
@@ -89,8 +115,8 @@ ClientResult K8sClient::DeleteRayService(const std::string& ns,
   std::map<std::string, std::string> headers{{"Accept", "application/json"}};
   std::string token = BearerToken();
   if (!token.empty()) headers["Authorization"] = "Bearer " + token;
-  return HttpDo("DELETE", cfg_.base_url + RayServicePath(ns, name), headers,
-                "", 30, cfg_.ca_file, cfg_.insecure);
+  return DoWithRetry("DELETE", cfg_.base_url + RayServicePath(ns, name),
+                     headers, "");
 }
 
 }  // namespace spotter

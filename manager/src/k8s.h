@@ -24,6 +24,12 @@ struct K8sConfig {
   std::string token_file;  // re-read per request when set (SA token rotation)
   std::string ca_file;     // CA bundle path ("" = system roots)
   bool insecure = false;   // tests only
+  // Apiserver calls fail fast and retry once (ISSUE 2): a preempted node
+  // hosting the apiserver endpoint must not wedge a /deploy handler thread.
+  // timeout covers connect+write+read per attempt (SPOTTER_K8S_TIMEOUT_S
+  // overrides); one retry after retry_backoff_ms on connect errors or 5xx.
+  int timeout_s = 30;
+  int retry_backoff_ms = 500;
 };
 
 // In-cluster discovery: KUBERNETES_SERVICE_HOST/PORT + serviceaccount token
@@ -46,6 +52,13 @@ class K8sClient {
  private:
   std::string RayServicePath(const std::string& ns, const std::string& name);
   std::string BearerToken();
+  // HttpDo with the config's timeout plus ONE retry (after retry_backoff_ms)
+  // on transport errors and 5xx — transient apiserver blips (connection
+  // refused during a control-plane restart, 500/503 under load) succeed on
+  // the second attempt; real errors still surface after ~one backoff.
+  ClientResult DoWithRetry(const std::string& method, const std::string& url,
+                           const std::map<std::string, std::string>& headers,
+                           const std::string& body);
   K8sConfig cfg_;
 };
 

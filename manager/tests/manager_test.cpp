@@ -10,6 +10,7 @@
 #include <unistd.h>
 
 #include <cstdio>
+#include <deque>
 #include <fstream>
 #include <mutex>
 #include <sstream>
@@ -56,7 +57,10 @@ inline std::string Str(int v) { return std::to_string(v); }
     }                                                                        \
   } while (0)
 
-// records every request; replies with a scripted (status, body)
+// records every request; replies with a scripted (status, body). When
+// `scripted_statuses` is non-empty, each request consumes the next status
+// in order (then falls back to reply_status) — how the retry tests script
+// "500 then 200".
 struct FakeServer {
   spotter::HttpServer server;
   std::mutex mu;
@@ -64,6 +68,7 @@ struct FakeServer {
   int reply_status = 200;
   std::string reply_body = "{}";
   std::map<std::string, std::string> reply_headers;
+  std::deque<int> scripted_statuses;
 
   void Start() {
     auto handler = [this](const spotter::HttpRequest& r) {
@@ -71,6 +76,10 @@ struct FakeServer {
       requests.push_back(r);
       spotter::HttpResponse resp;
       resp.status = reply_status;
+      if (!scripted_statuses.empty()) {
+        resp.status = scripted_statuses.front();
+        scripted_statuses.pop_front();
+      }
       resp.body = reply_body;
       resp.headers = reply_headers;
       return resp;
@@ -334,6 +343,82 @@ void TestDeployValidation() {
   EXPECT_EQ(resp.status, 405);
 }
 
+void TestK8sRetryOn5xx() {
+  // Transient apiserver failure (ISSUE 2): first attempt answers 500, the
+  // one-retry-with-backoff path replays and the second 200 wins. The fake
+  // apiserver's request count proves the retry actually went out.
+  FakeServer api;
+  api.scripted_statuses = {500, 200};
+  api.Start();
+  setenv("SPOTTER_K8S_BASE", api.Base().c_str(), 1);
+  spotter::K8sConfig kcfg;
+  std::string err;
+  spotter::LoadK8sConfig(&kcfg, &err);
+  kcfg.retry_backoff_ms = 10;  // keep the test fast
+  spotter::K8sClient client(kcfg);
+
+  auto result = client.ApplyRayService("spotter", "spotter-ray-service", "x: y\n");
+  EXPECT(result.ok, result.error.c_str());
+  EXPECT_EQ(result.status, 200);
+  EXPECT_EQ(static_cast<int>(api.Count()), 2);
+  api.Stop();
+
+  // 4xx is the caller's bug: no retry, one request only
+  FakeServer api2;
+  api2.reply_status = 404;
+  api2.Start();
+  setenv("SPOTTER_K8S_BASE", api2.Base().c_str(), 1);
+  spotter::K8sConfig kcfg2;
+  spotter::LoadK8sConfig(&kcfg2, &err);
+  kcfg2.retry_backoff_ms = 10;
+  spotter::K8sClient client2(kcfg2);
+  result = client2.DeleteRayService("spotter", "spotter-ray-service");
+  EXPECT_EQ(result.status, 404);
+  EXPECT_EQ(static_cast<int>(api2.Count()), 1);
+  api2.Stop();
+}
+
+void TestK8sRetryOnConnectError() {
+  // Dead endpoint: both attempts fail at the transport; the error surfaces
+  // after one backoff instead of wedging or succeeding silently.
+  spotter::K8sConfig kcfg;
+  kcfg.base_url = "http://127.0.0.1:9";  // discard port — connect refused
+  kcfg.timeout_s = 2;
+  kcfg.retry_backoff_ms = 10;
+  spotter::K8sClient client(kcfg);
+  auto result = client.ApplyRayService("spotter", "svc", "x: y\n");
+  EXPECT(!result.ok, "dead apiserver must fail after the retry");
+  EXPECT_CONTAINS(result.error, "connection failed");
+}
+
+void TestK8sTimeoutEnv() {
+  setenv("SPOTTER_K8S_BASE", "http://127.0.0.1:1", 1);
+  setenv("SPOTTER_K8S_TIMEOUT_S", "7", 1);
+  spotter::K8sConfig kcfg;
+  std::string err;
+  spotter::LoadK8sConfig(&kcfg, &err);
+  EXPECT_EQ(kcfg.timeout_s, 7);
+  unsetenv("SPOTTER_K8S_TIMEOUT_S");
+  spotter::K8sConfig kcfg2;
+  spotter::LoadK8sConfig(&kcfg2, &err);
+  EXPECT_EQ(kcfg2.timeout_s, 30);  // default
+}
+
+void TestManagerHealthRoutes() {
+  Fixture fx(kTemplate);
+  spotter::K8sClient client({});
+  spotter::HttpServer server;
+  spotter::RegisterRoutes(&server, fx.opts, &client);
+  EXPECT(server.Listen("127.0.0.1", 0), "listen");
+  server.Start();
+  std::string base = "http://127.0.0.1:" + std::to_string(server.port());
+  auto r = spotter::HttpDo("GET", base + "/healthz", {}, "", 5);
+  EXPECT_EQ(r.status, 200);
+  r = spotter::HttpDo("GET", base + "/livez", {}, "", 5);
+  EXPECT_EQ(r.status, 200);
+  server.Shutdown();
+}
+
 void TestDeployApiserverError() {
   Fixture fx(kTemplate);
   FakeServer api;
@@ -518,6 +603,10 @@ int main() {
   TestDeployRealTemplate();
   TestDeployBadTopology();
   TestDeployValidation();
+  TestK8sRetryOn5xx();
+  TestK8sRetryOnConnectError();
+  TestK8sTimeoutEnv();
+  TestManagerHealthRoutes();
   TestDeployApiserverError();
   TestDeployMissingTemplate();
   TestDeleteVariants();

@@ -32,6 +32,13 @@ class Metrics:
         self._breaker_state = "closed"
         self._breaker_transitions_total = 0
         self._draining = False
+        # Replica-lifecycle gauges (ISSUE 2): process-start -> ready (warm
+        # restart evidence) and how many times the supervisor has restarted
+        # this replica (set from SPOTTER_TPU_RESTARTS at bootstrap). Both
+        # live on the Metrics object, so they survive a drain/restart of the
+        # batcher — only a process death resets them.
+        self._time_to_ready_s: float | None = None
+        self._restarts_total = 0
 
     def record_batch(
         self,
@@ -84,6 +91,14 @@ class Metrics:
         with self._lock:
             self._draining = draining
 
+    def set_time_to_ready(self, seconds: float) -> None:
+        with self._lock:
+            self._time_to_ready_s = seconds
+
+    def set_restarts(self, n: int) -> None:
+        with self._lock:
+            self._restarts_total = n
+
     def snapshot(self) -> dict:
         with self._lock:
             lats = sorted(self._latencies_ms)
@@ -117,6 +132,8 @@ class Metrics:
                 "breaker_state": self._breaker_state,
                 "breaker_transitions_total": self._breaker_transitions_total,
                 "draining": self._draining,
+                "time_to_ready_s": self._time_to_ready_s,
+                "restarts_total": self._restarts_total,
                 "batches_total": self._batches_total,
                 "mean_batch_size": (
                     sum(self._batch_sizes) / len(self._batch_sizes) if self._batch_sizes else 0.0

@@ -19,6 +19,40 @@ logger = logging.getLogger(__name__)
 
 _COORD_PORT_DEFAULT = 8476
 
+# Coordinator-join timeout (ISSUE 2): jax.distributed.initialize's default is
+# 300 s of silent blocking; a preempted coordinator host would hang every
+# other worker's bring-up for 5 minutes before any error surfaces — longer
+# than the whole restart budget on spot capacity. 120 s still covers a slow
+# pod schedule while failing fast enough for the supervisor to retry.
+COORD_TIMEOUT_ENV = "SPOTTER_TPU_COORD_TIMEOUT_S"
+DEFAULT_COORD_TIMEOUT_S = 120
+
+
+def coordinator_timeout_s() -> int:
+    raw = os.environ.get(COORD_TIMEOUT_ENV, "").strip()
+    if not raw:
+        return DEFAULT_COORD_TIMEOUT_S
+    try:
+        timeout = int(float(raw))
+    except ValueError:
+        raise ValueError(
+            f"{COORD_TIMEOUT_ENV} must be a number of seconds, got {raw!r}"
+        ) from None
+    if timeout <= 0:
+        raise ValueError(f"{COORD_TIMEOUT_ENV} must be > 0, got {raw!r}")
+    return timeout
+
+
+def _distributed_is_initialized() -> bool:
+    """`jax.distributed.is_initialized` with a fallback for jax versions
+    that predate the public accessor (the distributed client global)."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    from jax._src.distributed import global_state
+
+    return global_state.client is not None
+
 
 def multihost_env_summary() -> dict:
     """The env contract the k8s template must satisfy (also used by tests)."""
@@ -28,6 +62,7 @@ def multihost_env_summary() -> dict:
         "SPOTTER_COORDINATOR_PORT": os.environ.get(
             "SPOTTER_COORDINATOR_PORT", str(_COORD_PORT_DEFAULT)
         ),
+        "SPOTTER_TPU_COORD_TIMEOUT_S": str(coordinator_timeout_s()),
     }
 
 
@@ -52,15 +87,29 @@ def initialize_multihost(force: bool = False) -> bool:
 
     hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
     coordinator = f"{hosts[0]}:{env['SPOTTER_COORDINATOR_PORT']}"
-    if jax.distributed.is_initialized():  # already up
+    if _distributed_is_initialized():  # already up
         return True
+    timeout_s = coordinator_timeout_s()
     logger.info(
-        "multihost init: coordinator=%s num_processes=%d process_id=%s",
-        coordinator, len(hosts), worker_id,
+        "multihost init: coordinator=%s num_processes=%d process_id=%s "
+        "timeout=%ds",
+        coordinator, len(hosts), worker_id, timeout_s,
     )
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=len(hosts),
-        process_id=int(worker_id),
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=len(hosts),
+            process_id=int(worker_id),
+            initialization_timeout=timeout_s,
+        )
+    except Exception as exc:
+        # A dead/preempted coordinator must read as a bounded, actionable
+        # failure (the supervisor's restart-with-backoff handles it), not a
+        # bring-up that silently never returns.
+        raise RuntimeError(
+            f"multihost bring-up failed (coordinator {coordinator}, "
+            f"join timeout {timeout_s} s — set {COORD_TIMEOUT_ENV} to adjust; "
+            f"a preempted coordinator host fails here instead of hanging): "
+            f"{exc}"
+        ) from exc
     return True

@@ -65,6 +65,14 @@ def build_detector_app(
     model_name = model_name or os.environ.get("MODEL_NAME")
     if not model_name:
         raise ValueError("MODEL_NAME environment variable not set.")
+    # Warm restart (ISSUE 2): arm JAX's persistent compilation cache
+    # (SPOTTER_TPU_COMPILE_CACHE_DIR) before the first jit — a preempted
+    # replica restarting on the same model + bucket ladder then loads its
+    # compiled programs from disk instead of recompiling them, which is
+    # most of time_to_ready_s.
+    from spotter_tpu.serving.lifecycle import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
     if batch_buckets is None:
         # Per-model ladder tuning is a deployment concern: R18's per-chip
         # peak is batch 16 (485 vs 449 img/s — BASELINE.md round-4 sweep),

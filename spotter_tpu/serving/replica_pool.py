@@ -1,0 +1,314 @@
+"""Async failover client pool: health-checked replicas, ejection, replay.
+
+A single hardened replica (ISSUE 1) still leaves clients staring at hard
+errors the moment that replica is preempted — on spot TPU capacity that is
+routine, not exceptional (Spotlight, arXiv:2606.19004). This pool is the
+fleet-side answer, DeepServe-style health-aware routing (arXiv:2501.14417)
+in one file:
+
+- **Selection**: round-robin over replicas that are neither ejected nor
+  marked unhealthy by the background health loop (`/healthz` readiness, so
+  a draining or breaker-open replica stops receiving traffic BEFORE it
+  starts refusing connections).
+- **Outlier ejection**: `eject_threshold` consecutive transport failures
+  eject a replica for an exponentially growing backoff (doubling up to
+  `backoff_max_s`); a later health-check success resets it.
+- **Replay**: a `/detect` attempt that dies on a transport error
+  (connection reset — the signature of a killed replica), times out, or
+  answers 5xx/429 is replayed against the next replica. Detection is
+  idempotent, so replay is safe; the client sees one answer, not the
+  preemption.
+- **Hedging** (optional): after `hedge_after_s` with no answer, a duplicate
+  fires at a second replica and the first response wins — the tail-latency
+  insurance for a replica that is technically alive but drowning.
+
+`bench.py --failover` drives this pool; `python -m spotter_tpu.serving.router`
+runs it as a tiny edge router. Counters surface in `snapshot()` (and the
+router's /metrics): ejections, replays, hedges, client-visible failures.
+"""
+
+import asyncio
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import httpx
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_EJECT_THRESHOLD = 3
+DEFAULT_BACKOFF_BASE_S = 0.5
+DEFAULT_BACKOFF_MAX_S = 30.0
+DEFAULT_HEALTH_INTERVAL_S = 0.5
+DEFAULT_REQUEST_TIMEOUT_S = 30.0
+
+# statuses that mean "this replica can't serve it right now, another might":
+# 429 queue-full, 503 draining/breaker, 500 engine fault
+REPLAYABLE_STATUSES = frozenset({429, 500, 502, 503})
+
+
+class PoolExhaustedError(RuntimeError):
+    """Every replica failed or was ejected for one request."""
+
+
+@dataclass
+class Replica:
+    url: str  # base URL, e.g. http://127.0.0.1:8001
+    healthy: bool = True
+    consecutive_failures: int = 0
+    ejected_until: float = 0.0
+    eject_backoff_s: float = 0.0
+    # diagnostics
+    requests: int = 0
+    failures: int = 0
+    ejections: int = 0
+    last_error: str = ""
+    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+    def available(self, now: float) -> bool:
+        return self.healthy and now >= self.ejected_until
+
+
+class ReplicaPool:
+    def __init__(
+        self,
+        endpoints: list[str],
+        client: Optional[httpx.AsyncClient] = None,
+        eject_threshold: int = DEFAULT_EJECT_THRESHOLD,
+        backoff_base_s: float = DEFAULT_BACKOFF_BASE_S,
+        backoff_max_s: float = DEFAULT_BACKOFF_MAX_S,
+        health_interval_s: float = DEFAULT_HEALTH_INTERVAL_S,
+        request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+        hedge_after_s: Optional[float] = None,
+        max_rounds: int = 2,
+        round_pause_s: float = 0.25,
+    ) -> None:
+        if not endpoints:
+            raise ValueError("ReplicaPool needs at least one endpoint")
+        self.replicas = [Replica(url=u.rstrip("/")) for u in endpoints]
+        self.client = client or httpx.AsyncClient(
+            timeout=httpx.Timeout(request_timeout_s, connect=2.0)
+        )
+        self._owns_client = client is None
+        self.eject_threshold = max(1, eject_threshold)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.health_interval_s = health_interval_s
+        self.hedge_after_s = hedge_after_s
+        self.max_rounds = max(1, max_rounds)
+        self.round_pause_s = round_pause_s
+        self._rr = itertools.count()
+        self._health_task: Optional[asyncio.Task] = None
+        # counters (event-loop only — no lock needed)
+        self.requests_total = 0
+        self.replays_total = 0
+        self.hedges_total = 0
+        self.hedge_wins_total = 0
+        self.ejections_total = 0
+        self.failures_total = 0  # client-visible (pool exhausted)
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        if self._health_task is None:
+            self._health_task = asyncio.create_task(self._health_loop())
+
+    async def stop(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+            try:
+                await self._health_task
+            except asyncio.CancelledError:
+                pass
+            self._health_task = None
+        if self._owns_client:
+            await self.client.aclose()
+
+    # ---- health ----
+
+    async def _probe(self, r: Replica) -> None:
+        try:
+            resp = await self.client.get(f"{r.url}/healthz", timeout=2.0)
+            ok = resp.status_code == 200
+        except Exception as exc:
+            ok = False
+            r.last_error = f"health: {exc!r}"
+        if ok:
+            self._record_success(r)
+        else:
+            r.healthy = False
+
+    async def _health_loop(self) -> None:
+        """Probe unavailable replicas so recovery (supervisor restart,
+        breaker close, drain replaced by a fresh pod) un-ejects them without
+        risking live traffic on a dead endpoint."""
+        while True:
+            now = time.monotonic()
+            probes = [
+                self._probe(r)
+                for r in self.replicas
+                if not r.healthy or r.ejected_until > now
+            ]
+            if probes:
+                await asyncio.gather(*probes, return_exceptions=True)
+            await asyncio.sleep(self.health_interval_s)
+
+    def _record_success(self, r: Replica) -> None:
+        r.consecutive_failures = 0
+        r.eject_backoff_s = 0.0
+        r.ejected_until = 0.0
+        r.healthy = True
+
+    def _record_failure(self, r: Replica, err: str) -> None:
+        r.failures += 1
+        r.last_error = err
+        r.consecutive_failures += 1
+        if r.consecutive_failures >= self.eject_threshold:
+            r.eject_backoff_s = min(
+                max(r.eject_backoff_s * 2.0, self.backoff_base_s),
+                self.backoff_max_s,
+            )
+            r.ejected_until = time.monotonic() + r.eject_backoff_s
+            r.ejections += 1
+            self.ejections_total += 1
+            logger.warning(
+                "replica %s ejected for %.1f s after %d consecutive failures (%s)",
+                r.url, r.eject_backoff_s, r.consecutive_failures, err,
+            )
+
+    # ---- routing ----
+
+    def _pick(self, exclude: set[str]) -> Optional[Replica]:
+        now = time.monotonic()
+        candidates = [
+            r for r in self.replicas
+            if r.url not in exclude and r.available(now)
+        ]
+        if not candidates:
+            # last resort: an ejected-but-not-excluded replica beats failing
+            # the client outright (its ejection may be stale)
+            candidates = [r for r in self.replicas if r.url not in exclude]
+        if not candidates:
+            return None
+        return candidates[next(self._rr) % len(candidates)]
+
+    async def _attempt(self, r: Replica, path: str, payload: dict):
+        r.requests += 1
+        resp = await self.client.post(f"{r.url}{path}", json=payload)
+        return resp
+
+    async def request(self, path: str, payload: dict) -> httpx.Response:
+        """POST `payload` with failover: try each distinct replica at most
+        once per round, replaying on transport errors and replayable
+        statuses; after a fully-failed round, pause briefly and run up to
+        `max_rounds - 1` more (a preemption that takes the whole pool down
+        for a beat — e.g. both replicas mid-drain — should cost the client
+        milliseconds, not an error). Raises PoolExhaustedError when every
+        round exhausted every replica."""
+        self.requests_total += 1
+        last_err = ""
+        for round_idx in range(self.max_rounds):
+            if round_idx:
+                await asyncio.sleep(self.round_pause_s)
+            tried: set[str] = set()
+            for attempt in range(len(self.replicas)):
+                r = self._pick(tried)
+                if r is None:
+                    break
+                tried.add(r.url)
+                try:
+                    if self.hedge_after_s is not None and attempt == 0:
+                        resp = await self._hedged_attempt(r, tried, path, payload)
+                    else:
+                        resp = await self._attempt(r, path, payload)
+                except Exception as exc:  # connect/reset/timeout — kill signature
+                    self._record_failure(r, repr(exc))
+                    last_err = f"{r.url}: {exc!r}"
+                    self.replays_total += 1
+                    continue
+                if resp.status_code in REPLAYABLE_STATUSES:
+                    # the replica answered but can't serve (draining,
+                    # breaker, queue full, engine fault): not a transport
+                    # outlier unless it keeps happening — count a failure,
+                    # replay elsewhere
+                    self._record_failure(r, f"HTTP {resp.status_code}")
+                    last_err = f"{r.url}: HTTP {resp.status_code}"
+                    self.replays_total += 1
+                    continue
+                self._record_success(r)
+                return resp
+        self.failures_total += 1
+        raise PoolExhaustedError(
+            f"all {len(self.replicas)} replicas failed over "
+            f"{self.max_rounds} rounds (last: {last_err})"
+        )
+
+    async def _hedged_attempt(
+        self, first: Replica, tried: set[str], path: str, payload: dict
+    ) -> httpx.Response:
+        """Fire at `first`; if no answer within hedge_after_s, also fire at a
+        second replica and take whichever succeeds first (the loser is
+        cancelled). An error from every in-flight attempt propagates so
+        request()'s replay logic treats it like an unhedged failure."""
+        primary = asyncio.create_task(self._attempt(first, path, payload))
+        done, _ = await asyncio.wait({primary}, timeout=self.hedge_after_s)
+        if done:
+            return primary.result()  # success or raise-through to replay
+        backup_replica = self._pick(tried | {first.url})
+        if backup_replica is None:  # nowhere to hedge: wait the primary out
+            return await primary
+        self.hedges_total += 1
+        backup = asyncio.create_task(self._attempt(backup_replica, path, payload))
+        pending = {primary, backup}
+        last_exc: Optional[BaseException] = None
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in done:
+                if t.exception() is None:
+                    for p in pending:
+                        p.cancel()
+                    if t is backup:
+                        self.hedge_wins_total += 1
+                        self._record_success(backup_replica)
+                    return t.result()
+                last_exc = t.exception()
+                if t is backup:  # request() only accounts for `first`
+                    self._record_failure(backup_replica, repr(last_exc))
+        assert last_exc is not None
+        raise last_exc
+
+    async def detect(self, payload: dict) -> dict:
+        """POST /detect through the pool; returns the decoded JSON body."""
+        resp = await self.request("/detect", payload)
+        return resp.json()
+
+    # ---- observability ----
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        return {
+            "pool_requests_total": self.requests_total,
+            "pool_replays_total": self.replays_total,
+            "pool_hedges_total": self.hedges_total,
+            "pool_hedge_wins_total": self.hedge_wins_total,
+            "pool_ejections_total": self.ejections_total,
+            "pool_failures_total": self.failures_total,
+            "replicas": [
+                {
+                    "url": r.url,
+                    "healthy": r.healthy,
+                    "available": r.available(now),
+                    "ejected_for_s": max(r.ejected_until - now, 0.0),
+                    "consecutive_failures": r.consecutive_failures,
+                    "requests": r.requests,
+                    "failures": r.failures,
+                    "ejections": r.ejections,
+                    "last_error": r.last_error,
+                }
+                for r in self.replicas
+            ],
+        }

@@ -1,0 +1,118 @@
+"""Tiny edge router over a ReplicaPool: `python -m spotter_tpu.serving.router`.
+
+The C++ manager proxy stays a deliberate pass-through (README "Decision");
+this router is the piece that sits where a client-side pool can't — in
+front of browsers/SDKs that speak plain HTTP to ONE address while the
+replica fleet behind it churns (preemptions, restarts, drains). Routes:
+
+- POST /detect  — forwarded through the pool (health-aware selection,
+  ejection, replay, optional hedging); a request fails only when EVERY
+  replica fails.
+- GET  /healthz — 200 while at least one replica is available (the router
+  itself is an LB target).
+- GET  /livez   — router process liveness.
+- GET  /metrics — pool counters + per-replica state (ejections, replays,
+  hedges, failures).
+
+Endpoints come from --endpoints or SPOTTER_TPU_REPLICAS (comma-separated
+base URLs). This is the edge half of the failover acceptance test: the
+chaos suite drives the same ReplicaPool in-process.
+"""
+
+import argparse
+import json
+import logging
+import os
+import time
+
+from aiohttp import web
+
+from spotter_tpu.serving.replica_pool import PoolExhaustedError, ReplicaPool
+
+logger = logging.getLogger(__name__)
+
+REPLICAS_ENV = "SPOTTER_TPU_REPLICAS"
+HEDGE_ENV = "SPOTTER_TPU_HEDGE_MS"
+
+
+def make_router_app(pool: ReplicaPool) -> web.Application:
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["pool"] = pool
+
+    async def on_startup(app: web.Application) -> None:
+        await pool.start()
+
+    async def on_cleanup(app: web.Application) -> None:
+        await pool.stop()
+
+    async def detect(request: web.Request) -> web.Response:
+        try:
+            payload = await request.json()
+        except json.JSONDecodeError:
+            return web.Response(status=400, text="Invalid JSON body")
+        try:
+            resp = await pool.request("/detect", payload)
+        except PoolExhaustedError as exc:
+            return web.json_response(
+                {"error": str(exc), "status": 503},
+                status=503,
+                headers={"Retry-After": "1"},
+            )
+        return web.Response(
+            status=resp.status_code,
+            body=resp.content,
+            content_type="application/json",
+        )
+
+    async def healthz(request: web.Request) -> web.Response:
+        now = time.monotonic()
+        available = sum(1 for r in pool.replicas if r.available(now))
+        return web.json_response(
+            {"available_replicas": available, "total_replicas": len(pool.replicas)},
+            status=200 if available > 0 else 503,
+        )
+
+    async def livez(request: web.Request) -> web.Response:
+        return web.json_response({"status": "alive"})
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.json_response(pool.snapshot())
+
+    app.router.add_post("/detect", detect)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/livez", livez)
+    app.router.add_get("/metrics", metrics)
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="spotter-tpu failover edge router")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument(
+        "--endpoints",
+        default=os.environ.get(REPLICAS_ENV, ""),
+        help=f"comma-separated replica base URLs (default {REPLICAS_ENV})",
+    )
+    parser.add_argument(
+        "--hedge-ms",
+        type=float,
+        default=float(os.environ.get(HEDGE_ENV, "0") or "0"),
+        help="hedge a second replica after this many ms (0 = off)",
+    )
+    args = parser.parse_args()
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    if not endpoints:
+        raise SystemExit(f"no replica endpoints: pass --endpoints or set {REPLICAS_ENV}")
+    logging.basicConfig(level=logging.INFO)
+    pool = ReplicaPool(
+        endpoints,
+        hedge_after_s=args.hedge_ms / 1000.0 if args.hedge_ms > 0 else None,
+    )
+    web.run_app(make_router_app(pool), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,20 @@ the split k8s needs to stop routing without restarting the pod; /drain is
 the preStop hook: stop admitting, flush the queue, wait for in-flight
 batches. SPOTTER_TPU_FAULTS arms the fault-injection harness
 (spotter_tpu/testing/faults.py) for chaos staging — loud at startup.
+
+Replica lifecycle (ISSUE 2): the HTTP surface binds BEFORE the model loads —
+bring-up runs as a background task through the `loading -> warming -> ready`
+state machine exposed at /startupz, so a k8s startupProbe can wait out a
+long warmup without the pod being killed (readiness stays 503 throughout).
+`SPOTTER_TPU_COMPILE_CACHE_DIR` arms JAX's persistent compilation cache
+before the engine compiles, making a post-preemption restart warm;
+`time_to_ready_s` and `restarts_total` (from `SPOTTER_TPU_RESTARTS`, set by
+the supervisor) land in /metrics. A `PreemptionWatcher` (SIGTERM + the
+`SPOTTER_TPU_PREEMPTION_FILE`/`_URL` maintenance source) drains and exits
+with the distinct preemption code. When `SPOTTER_TPU_ADMIN_TOKEN` is set,
+the state-changing admin endpoints (/drain, /profile) require it in the
+`X-Admin-Token` header — without the guard any client could drain a replica
+out of the fleet or trigger a trace capture.
 """
 
 import argparse
@@ -25,12 +39,14 @@ import tempfile
 import pydantic
 from aiohttp import web
 
-from spotter_tpu.engine import profiler
-from spotter_tpu.serving.app import build_detector_app
+from spotter_tpu.serving import lifecycle
 from spotter_tpu.serving.resilience import AdmissionError
-from spotter_tpu.testing import faults
+from spotter_tpu.testing import faults, stub_engine
 
 logger = logging.getLogger(__name__)
+
+ADMIN_TOKEN_ENV = "SPOTTER_TPU_ADMIN_TOKEN"
+ADMIN_TOKEN_HEADER = "X-Admin-Token"
 
 
 def _rmdir_quiet(path: str) -> None:
@@ -49,19 +65,112 @@ def _shed_response(exc: AdmissionError) -> web.Response:
     )
 
 
-def make_app(detector=None, model_name: str | None = None, warmup: bool = False) -> web.Application:
+def _not_ready_response(tracker: lifecycle.StartupTracker) -> web.Response:
+    return web.json_response(
+        {"error": f"replica starting up ({tracker.state})", "status": 503},
+        status=503,
+        headers={"Retry-After": "2"},
+    )
+
+
+def _admin_rejection(request: web.Request) -> web.Response | None:
+    """401 when SPOTTER_TPU_ADMIN_TOKEN is set and the request lacks it.
+
+    Read per request (not at app build) so rotation via env + restart of the
+    guard is trivial and tests cover both modes without rebuilding the app.
+    """
+    token = os.environ.get(ADMIN_TOKEN_ENV, "")
+    if not token:
+        return None  # open mode: no token configured
+    if request.headers.get(ADMIN_TOKEN_HEADER, "") == token:
+        return None
+    return web.json_response(
+        {"error": f"admin endpoint requires {ADMIN_TOKEN_HEADER}", "status": 401},
+        status=401,
+    )
+
+
+def _build_detector_blocking(model_name: str | None):
+    """The heavy half of bring-up, run in an executor: compile-cache arming
+    must precede the first jit, then the model/engine build."""
+    lifecycle.maybe_enable_compile_cache()
+    if stub_engine.stub_mode_enabled():
+        logger.warning(
+            "STUB ENGINE ACTIVE (%s) — canned detections, no device; "
+            "never production", stub_engine.STUB_ENGINE_ENV,
+        )
+        return stub_engine.build_stub_detector()
+    from spotter_tpu.serving.app import build_detector_app
+
+    return build_detector_app(model_name, warmup=False)
+
+
+def make_app(
+    detector=None,
+    model_name: str | None = None,
+    warmup: bool = False,
+    preemption: bool = False,
+) -> web.Application:
+    """Build the serving app.
+
+    With `detector` given (tests), the app is ready immediately. Otherwise
+    bring-up runs as a background task after the HTTP surface binds: the
+    startupProbe watches /startupz while the model loads and warms.
+    `preemption=True` (the `main()` path) installs the PreemptionWatcher.
+    """
     app = web.Application(client_max_size=64 * 1024 * 1024)
-    app["detector"] = detector or build_detector_app(model_name, warmup=warmup)
-    profiler.maybe_start_profiler_server()
+    tracker = lifecycle.StartupTracker()
+    app["startup"] = tracker
+    app["detector"] = detector
     if faults.maybe_activate_from_env() is not None:
         logger.warning(
             "FAULT INJECTION ACTIVE (%s) — this server is a chaos target, "
             "never production",
             faults.FAULTS_ENV,
         )
+    if detector is not None:
+        detector.engine.metrics.set_restarts(lifecycle.restarts_from_env())
+        tracker.mark_ready(detector.engine.metrics)
+
+    async def _bring_up(app: web.Application) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            det = await loop.run_in_executor(
+                None, _build_detector_blocking, model_name
+            )
+            tracker.mark(lifecycle.WARMING)
+            if warmup:
+                await loop.run_in_executor(None, det.engine.warmup)
+            app["detector"] = det
+            det.engine.metrics.set_restarts(lifecycle.restarts_from_env())
+            ttr = tracker.mark_ready(det.engine.metrics)
+            logger.info("replica ready in %.1f s", ttr)
+        except Exception:
+            logger.exception("replica bring-up failed")
+            raise
+
+    async def on_startup(app: web.Application) -> None:
+        # profiler server after the loop exists; tasks stored for cleanup
+        from spotter_tpu.engine import profiler
+
+        profiler.maybe_start_profiler_server()
+        if app["detector"] is None:
+            app["bringup_task"] = asyncio.create_task(_bring_up(app))
+        if preemption:
+            async def drain_on_preempt():
+                det = app["detector"]
+                if det is not None:
+                    await det.drain()
+
+            watcher = lifecycle.PreemptionWatcher(drain_on_preempt)
+            app["preemption_watcher"] = watcher
+            await watcher.start()
 
     async def detect(request: web.Request) -> web.Response:
-        shed = request.app["detector"].check_admission()
+        det = request.app["detector"]
+        if det is None:  # still loading/warming: shed, probe /startupz
+            return _not_ready_response(tracker)
+        shed = det.check_admission()
         if shed is not None:  # draining / breaker open: reject before fetching
             return _shed_response(shed)
         try:
@@ -69,7 +178,7 @@ def make_app(detector=None, model_name: str | None = None, warmup: bool = False)
         except json.JSONDecodeError:
             return web.Response(status=400, text="Invalid JSON body")
         try:
-            response = await request.app["detector"].detect(payload)
+            response = await det.detect(payload)
         except pydantic.ValidationError as exc:
             return web.Response(status=400, text=f"Invalid request: {exc}")
         except AdmissionError as exc:  # every image shed -> 429/503
@@ -79,11 +188,21 @@ def make_app(detector=None, model_name: str | None = None, warmup: bool = False)
             return web.Response(status=500, text="Internal server error")
         return web.json_response(response.model_dump())
 
+    async def startupz(request: web.Request) -> web.Response:
+        """Startup probe: 200 only once the replica reached ready. A long
+        warmup answers 503 with the state, which a startupProbe tolerates up
+        to its failureThreshold — unlike a liveness probe, it won't kill."""
+        snap = tracker.snapshot()
+        return web.json_response(snap, status=200 if tracker.ready else 503)
+
     async def healthz(request: web.Request) -> web.Response:
-        """Readiness: 503 drops this replica from the LB while the breaker
-        is open or a drain is in progress; recovery (successful half-open
-        probe) flips it back to 200."""
-        health = request.app["detector"].health()
+        """Readiness: 503 drops this replica from the LB while starting up,
+        while the breaker is open, or while a drain is in progress."""
+        det = request.app["detector"]
+        if det is None:
+            return _not_ready_response(tracker)
+        health = det.health()
+        health["startup"] = tracker.state
         return web.json_response(health, status=200 if health["ready"] else 503)
 
     async def livez(request: web.Request) -> web.Response:
@@ -92,12 +211,22 @@ def make_app(detector=None, model_name: str | None = None, warmup: bool = False)
 
     async def drain(request: web.Request) -> web.Response:
         """k8s preStop: stop admitting, flush the queue, wait for in-flight
-        batches. Idempotent — a second call reports the drained state."""
-        summary = await request.app["detector"].drain()
+        batches. Idempotent — a second call reports the drained state.
+        Guarded by SPOTTER_TPU_ADMIN_TOKEN when set."""
+        rejected = _admin_rejection(request)
+        if rejected is not None:
+            return rejected
+        det = request.app["detector"]
+        if det is None:
+            return _not_ready_response(tracker)
+        summary = await det.drain()
         return web.json_response(summary)
 
     async def metrics(request: web.Request) -> web.Response:
-        return web.json_response(request.app["detector"].engine.metrics.snapshot())
+        det = request.app["detector"]
+        if det is None:
+            return web.json_response({"startup": tracker.snapshot()})
+        return web.json_response(det.engine.metrics.snapshot())
 
     async def profile(request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of in-flight device work.
@@ -105,8 +234,13 @@ def make_app(detector=None, model_name: str | None = None, warmup: bool = False)
         Body (optional JSON): {"duration_s": 1.0}. The server picks the
         trace directory (under SPOTTER_TPU_PROFILE_DIR or the system temp
         dir — never a client-supplied path) and returns it; open it with
-        TensorBoard/xprof.
+        TensorBoard/xprof. Guarded by SPOTTER_TPU_ADMIN_TOKEN when set.
         """
+        rejected = _admin_rejection(request)
+        if rejected is not None:
+            return rejected
+        from spotter_tpu.engine import profiler
+
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -134,14 +268,27 @@ def make_app(detector=None, model_name: str | None = None, warmup: bool = False)
         return web.json_response(summary)
 
     async def on_cleanup(app: web.Application) -> None:
-        await app["detector"].aclose()
+        task = app.get("bringup_task")
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        watcher = app.get("preemption_watcher")
+        if watcher is not None:
+            await watcher.stop()
+        if app["detector"] is not None:
+            await app["detector"].aclose()
 
     app.router.add_post("/detect", detect)
+    app.router.add_get("/startupz", startupz)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/livez", livez)
     app.router.add_post("/drain", drain)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/profile", profile)
+    app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app
 
@@ -152,10 +299,20 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--model", default=None, help="overrides MODEL_NAME env")
     parser.add_argument("--no-warmup", action="store_true")
+    parser.add_argument(
+        "--stub-engine",
+        action="store_true",
+        help=f"canned-detection stub engine ({stub_engine.STUB_ENGINE_ENV}=1); "
+        "failover tests/bench only",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    if args.stub_engine:
+        os.environ[stub_engine.STUB_ENGINE_ENV] = "1"
     web.run_app(
-        make_app(model_name=args.model, warmup=not args.no_warmup),
+        make_app(
+            model_name=args.model, warmup=not args.no_warmup, preemption=True
+        ),
         host=args.host,
         port=args.port,
     )

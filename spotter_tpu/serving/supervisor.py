@@ -1,0 +1,165 @@
+"""Subprocess supervisor: restart a crashed/preempted replica with backoff.
+
+k8s restarts pods, but inside a pod (and on bare VMs, and in the failover
+test/bench harness) something must bring a dead server back — and do it in
+seconds when the death was a preemption that already drained cleanly, while
+NOT hot-looping when the server crashes at import time. Policy:
+
+- exit 0 (operator stop) → supervisor exits 0;
+- `PREEMPTED_EXIT_CODE` (drained preemption exit, serving/lifecycle.py) →
+  immediate restart, backoff reset: the replica told us it shut down
+  healthy;
+- any other exit → restart after exponential backoff (`--backoff-base`,
+  doubling to `--backoff-max`); a child that stayed up ≥ `--min-uptime`
+  resets the backoff;
+- crash-loop circuit: more than `--crash-loop` consecutive sub-min-uptime
+  crashes → give up and exit non-zero (let the orchestrator above decide).
+
+Each (re)start exports `SPOTTER_TPU_RESTARTS=<n>` to the child so
+`restarts_total` lands in the replica's /metrics, and rewrites `--pidfile`
+so harnesses (tests, bench.py --failover) can target the CURRENT child with
+preemption faults. SIGTERM to the supervisor forwards to the child and
+exits with the child's code — the pod-level preStop path stays intact.
+"""
+
+import argparse
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from spotter_tpu.serving.lifecycle import PREEMPTED_EXIT_CODE, RESTARTS_ENV
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_BACKOFF_BASE_S = 0.5
+DEFAULT_BACKOFF_MAX_S = 30.0
+DEFAULT_MIN_UPTIME_S = 5.0
+DEFAULT_CRASH_LOOP_LIMIT = 5
+CRASH_LOOP_EXIT_CODE = 84  # distinct from the child's codes and from 83
+
+
+class Supervisor:
+    def __init__(
+        self,
+        cmd: list[str],
+        backoff_base_s: float = DEFAULT_BACKOFF_BASE_S,
+        backoff_max_s: float = DEFAULT_BACKOFF_MAX_S,
+        min_uptime_s: float = DEFAULT_MIN_UPTIME_S,
+        crash_loop_limit: int = DEFAULT_CRASH_LOOP_LIMIT,
+        pidfile: str | None = None,
+    ) -> None:
+        if not cmd:
+            raise ValueError("supervisor needs a command")
+        self.cmd = cmd
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.min_uptime_s = min_uptime_s
+        self.crash_loop_limit = crash_loop_limit
+        self.pidfile = pidfile
+        self.restarts_total = 0
+        self.child: subprocess.Popen | None = None
+        self._terminating = False
+
+    def _spawn(self) -> subprocess.Popen:
+        env = dict(os.environ)
+        env[RESTARTS_ENV] = str(self.restarts_total)
+        child = subprocess.Popen(self.cmd, env=env)
+        if self.pidfile:
+            tmp = f"{self.pidfile}.tmp"
+            with open(tmp, "w") as f:
+                f.write(str(child.pid))
+            os.replace(tmp, self.pidfile)  # atomic: readers never see partial
+        logger.info(
+            "spawned child pid=%d (restart #%d): %s",
+            child.pid, self.restarts_total, " ".join(self.cmd),
+        )
+        return child
+
+    def _forward_term(self, signum, frame) -> None:
+        self._terminating = True
+        if self.child is not None and self.child.poll() is None:
+            self.child.send_signal(signal.SIGTERM)
+
+    def run(self) -> int:
+        """Supervise until the child exits cleanly, the crash-loop circuit
+        trips, or SIGTERM. Returns the exit code to propagate."""
+        signal.signal(signal.SIGTERM, self._forward_term)
+        backoff = 0.0
+        consecutive_fast_crashes = 0
+        while True:
+            started = time.monotonic()
+            self.child = self._spawn()
+            code = self.child.wait()
+            uptime = time.monotonic() - started
+            if self._terminating:
+                logger.info("terminated; child exited %d", code)
+                return code
+            if code == 0:
+                logger.info("child exited cleanly; supervisor done")
+                return 0
+            if code == PREEMPTED_EXIT_CODE:
+                # drained preemption: the replica is healthy software on
+                # yanked capacity — restart immediately, no backoff debt
+                logger.warning("child preempted (exit %d); immediate warm restart", code)
+                backoff = 0.0
+                consecutive_fast_crashes = 0
+            else:
+                if uptime >= self.min_uptime_s:
+                    backoff = 0.0
+                    consecutive_fast_crashes = 0
+                else:
+                    consecutive_fast_crashes += 1
+                    if consecutive_fast_crashes > self.crash_loop_limit:
+                        logger.error(
+                            "crash loop: %d consecutive crashes under %.1f s "
+                            "uptime; giving up",
+                            consecutive_fast_crashes, self.min_uptime_s,
+                        )
+                        return CRASH_LOOP_EXIT_CODE
+                backoff = min(
+                    max(backoff * 2.0, self.backoff_base_s), self.backoff_max_s
+                )
+                logger.warning(
+                    "child crashed (exit %d, uptime %.1f s); restarting in %.2f s",
+                    code, uptime, backoff,
+                )
+                time.sleep(backoff)
+            self.restarts_total += 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="spotter-tpu replica supervisor",
+        usage="python -m spotter_tpu.serving.supervisor [opts] -- CMD [ARG...]",
+    )
+    parser.add_argument("--backoff-base", type=float, default=DEFAULT_BACKOFF_BASE_S)
+    parser.add_argument("--backoff-max", type=float, default=DEFAULT_BACKOFF_MAX_S)
+    parser.add_argument("--min-uptime", type=float, default=DEFAULT_MIN_UPTIME_S)
+    parser.add_argument("--crash-loop", type=int, default=DEFAULT_CRASH_LOOP_LIMIT)
+    parser.add_argument("--pidfile", default=None,
+                        help="rewritten with the current child pid on every spawn")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="child command (after --)")
+    args = parser.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no child command given (use -- CMD ARG...)")
+    logging.basicConfig(level=logging.INFO)
+    sup = Supervisor(
+        cmd,
+        backoff_base_s=args.backoff_base,
+        backoff_max_s=args.backoff_max,
+        min_uptime_s=args.min_uptime,
+        crash_loop_limit=args.crash_loop,
+        pidfile=args.pidfile,
+    )
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

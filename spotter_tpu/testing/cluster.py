@@ -1,0 +1,162 @@
+"""Local multi-replica harness: supervised stub replicas as subprocesses.
+
+The failover acceptance test (tests/test_failover.py) and
+`bench.py --failover` need the same fixture: N REAL server processes (the
+standalone aiohttp runtime, stub engine, full lifecycle surface) each under
+the REAL supervisor, on localhost ports, killable mid-load — the CPU
+stand-in for a spot TPU fleet losing a host. This module is that fixture.
+
+Hermeticity mirrors tests/test_multihost.py: the spawned processes must not
+inherit the session's TPU-tunnel PJRT plugin or the virtual-device XLA flag,
+and always run JAX_PLATFORMS=cpu.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def pick_ports(n: int) -> list[int]:
+    """Ephemeral localhost ports (bound briefly, then released)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _hermetic_env(extra: dict | None = None) -> dict:
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="",
+        SPOTTER_TPU_STUB_ENGINE="1",
+        PYTHONPATH=REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    for var in (
+        "PJRT_LIBRARY_PATH",
+        "PJRT_NAMES_AND_LIBRARY_PATHS",
+        "PALLAS_AXON_POOL_IPS",
+        "SPOTTER_TPU_FAULTS",
+        "SPOTTER_TPU_ADMIN_TOKEN",
+    ):
+        env.pop(var, None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+class SupervisedReplica:
+    """One supervisor subprocess running one stub standalone server."""
+
+    def __init__(
+        self,
+        port: int,
+        pidfile: str,
+        backoff_base_s: float = 0.2,
+        min_uptime_s: float = 0.5,
+        env: dict | None = None,
+    ) -> None:
+        self.port = port
+        self.url = f"http://127.0.0.1:{port}"
+        self.pidfile = pidfile
+        cmd = [
+            sys.executable, "-m", "spotter_tpu.serving.supervisor",
+            "--backoff-base", str(backoff_base_s),
+            "--min-uptime", str(min_uptime_s),
+            "--pidfile", pidfile,
+            "--",
+            sys.executable, "-m", "spotter_tpu.serving.standalone",
+            "--stub-engine", "--no-warmup",
+            "--host", "127.0.0.1", "--port", str(port),
+        ]
+        self.proc = subprocess.Popen(
+            cmd,
+            env=_hermetic_env(env),
+            cwd=REPO_ROOT,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+
+    def child_pid(self) -> int | None:
+        try:
+            with open(self.pidfile) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def kill_child(self, sig: int = signal.SIGKILL) -> int:
+        """The preemption fault: kill the SERVER (the supervisor stays and
+        must restart it). Returns the killed pid."""
+        pid = self.child_pid()
+        if pid is None:
+            raise RuntimeError(f"no child pid recorded in {self.pidfile}")
+        os.kill(pid, sig)
+        return pid
+
+    def shutdown(self, timeout_s: float = 10.0) -> str:
+        """SIGTERM the supervisor (it forwards to the child) and collect
+        output."""
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = self.proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            out, _ = self.proc.communicate()
+        return out or ""
+
+
+def wait_ready(url: str, timeout_s: float = 60.0, interval_s: float = 0.1) -> float:
+    """Block until `url`/startupz answers 200; returns seconds waited.
+    Raises TimeoutError with the last observed state on expiry."""
+    import httpx
+
+    t0 = time.monotonic()
+    last = "no answer yet"
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            resp = httpx.get(f"{url}/startupz", timeout=2.0)
+            if resp.status_code == 200:
+                return time.monotonic() - t0
+            last = f"HTTP {resp.status_code}: {resp.text[:120]}"
+        except Exception as exc:
+            last = repr(exc)
+        time.sleep(interval_s)
+    raise TimeoutError(f"{url} not ready after {timeout_s} s (last: {last})")
+
+
+def start_replicas(
+    n: int, workdir: str, **replica_kwargs
+) -> list[SupervisedReplica]:
+    """Spawn + wait-ready N supervised stub replicas. On any bring-up
+    failure, everything spawned so far is torn down with its output in the
+    raised error."""
+    ports = pick_ports(n)
+    replicas = [
+        SupervisedReplica(
+            port, os.path.join(workdir, f"replica-{port}.pid"), **replica_kwargs
+        )
+        for port in ports
+    ]
+    try:
+        for r in replicas:
+            wait_ready(r.url)
+    except Exception:
+        outputs = [r.shutdown() for r in replicas]
+        raise RuntimeError(
+            "replica bring-up failed:\n" + "\n---\n".join(o[-2000:] for o in outputs)
+        ) from None
+    return replicas

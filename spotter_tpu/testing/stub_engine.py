@@ -1,0 +1,98 @@
+"""Stub engine + stub fetch for model-free multi-replica testing (ISSUE 2).
+
+The failover/chaos layer under test is everything ABOVE the forward pass:
+startup state machine, drain, supervisor restart, pool replay. A real model
+would add minutes of compile per replica subprocess and prove nothing about
+that layer, so `SPOTTER_TPU_STUB_ENGINE=1` (or `--stub-engine`) makes the
+standalone server run this engine instead: canned detections, optional fixed
+service time (`SPOTTER_TPU_STUB_SERVICE_MS`) so load tests have a realistic
+queueing profile, no jax device work, CPU-safe. The stub also short-circuits
+image fetching (the detector's httpx client is replaced by `StubHttpClient`)
+so request URLs never leave the process.
+
+Never production: the standalone server logs loudly when stub mode is on,
+the same way it does for SPOTTER_TPU_FAULTS.
+"""
+
+import os
+import time
+from io import BytesIO
+
+STUB_ENGINE_ENV = "SPOTTER_TPU_STUB_ENGINE"
+STUB_SERVICE_MS_ENV = "SPOTTER_TPU_STUB_SERVICE_MS"
+
+# Labels must be AMENITIES_MAPPING keys so stub responses contain real
+# detections end-to-end (taxonomy.py: "tv" -> "TV").
+STUB_DETECTIONS = [{"label": "tv", "score": 0.9, "box": [2.0, 2.0, 20.0, 24.0]}]
+
+
+def stub_image_bytes(w: int = 32, h: int = 32) -> bytes:
+    import numpy as np
+    from PIL import Image
+
+    img = Image.fromarray(np.full((h, w, 3), 128, np.uint8))
+    buf = BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+class StubEngine:
+    """Duck-typed InferenceEngine: metrics + batch_buckets + detect()."""
+
+    def __init__(self, service_ms: float | None = None) -> None:
+        from spotter_tpu.engine.metrics import Metrics
+
+        if service_ms is None:
+            raw = os.environ.get(STUB_SERVICE_MS_ENV, "").strip()
+            service_ms = float(raw) if raw else 0.0
+        self.service_s = max(service_ms, 0.0) / 1000.0
+        self.metrics = Metrics()
+        self.batch_buckets = (1, 2, 4, 8)
+
+    def warmup(self) -> None:  # parity with InferenceEngine's surface
+        pass
+
+    def detect(self, images):
+        t0 = time.monotonic()
+        if self.service_s > 0:
+            time.sleep(self.service_s)
+        out = [list(STUB_DETECTIONS) for _ in images]
+        self.metrics.record_batch(len(images), time.monotonic() - t0)
+        return out
+
+
+class _StubResponse:
+    def __init__(self, content: bytes) -> None:
+        self.content = content
+
+    def raise_for_status(self) -> None:
+        pass
+
+
+class StubHttpClient:
+    """Replaces the detector's httpx.AsyncClient in stub mode: every GET
+    "fetches" the same tiny JPEG without touching the network."""
+
+    def __init__(self) -> None:
+        self._bytes = stub_image_bytes()
+
+    async def get(self, url: str) -> _StubResponse:
+        return _StubResponse(self._bytes)
+
+    async def aclose(self) -> None:
+        pass
+
+
+def stub_mode_enabled() -> bool:
+    return os.environ.get(STUB_ENGINE_ENV, "0") not in ("", "0")
+
+
+def build_stub_detector():
+    """AmenitiesDetector over a StubEngine + StubHttpClient (the standalone
+    server's bring-up path when stub mode is on)."""
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.serving.detector import AmenitiesDetector
+
+    engine = StubEngine()
+    batcher = MicroBatcher(engine, max_delay_ms=2.0)
+    return AmenitiesDetector(engine, batcher, StubHttpClient())

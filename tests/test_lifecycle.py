@@ -1,0 +1,428 @@
+"""Replica-lifecycle tests (ISSUE 2): startup state machine + /startupz,
+preemption watcher (file source + explicit trigger), admin-token guard on
+state-changing endpoints, compile-cache env plumbing, and the /metrics
+lifecycle fields surviving a drain/restart cycle."""
+
+import asyncio
+import os
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from spotter_tpu.engine.batcher import MicroBatcher
+from spotter_tpu.serving import lifecycle
+from spotter_tpu.serving.detector import AmenitiesDetector
+from spotter_tpu.serving.resilience import CircuitBreaker
+from spotter_tpu.serving.standalone import ADMIN_TOKEN_ENV, ADMIN_TOKEN_HEADER, make_app
+from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+
+def _detector():
+    engine = StubEngine()
+    batcher = MicroBatcher(
+        engine,
+        max_delay_ms=1.0,
+        breaker=CircuitBreaker(threshold=100, metrics=engine.metrics),
+    )
+    return AmenitiesDetector(engine, batcher, StubHttpClient()), engine
+
+
+# ---- startup state machine ----
+
+
+def test_startup_tracker_transitions():
+    tracker = lifecycle.StartupTracker()
+    assert tracker.state == lifecycle.LOADING and not tracker.ready
+    tracker.mark(lifecycle.WARMING)
+    assert tracker.state == lifecycle.WARMING and not tracker.ready
+    engine = StubEngine()
+    ttr = tracker.mark_ready(engine.metrics)
+    assert tracker.ready and ttr > 0
+    assert engine.metrics.snapshot()["time_to_ready_s"] == ttr
+    with pytest.raises(ValueError):
+        tracker.mark("bogus")
+
+
+def test_startupz_endpoint_with_prebuilt_detector():
+    detector, engine = _detector()
+
+    async def run():
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.get("/startupz")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["state"] == "ready"
+            assert body["time_to_ready_s"] > 0
+
+    asyncio.run(run())
+
+
+def test_startupz_503_while_loading_and_detect_shed():
+    """While bring-up runs, /startupz and /healthz answer 503 (startupProbe
+    territory), /livez 200, and /detect sheds with Retry-After instead of
+    erroring — then everything flips once the build completes."""
+
+    async def run(monkeypatch_release: asyncio.Event):
+        app = make_app(detector=None, model_name="unused")
+
+        # substitute a slow bring-up for the real model build
+        async def fake_bring_up(app):
+            await monkeypatch_release.wait()
+            det, engine = _detector()
+            app["startup"].mark(lifecycle.WARMING)
+            app["detector"] = det
+            app["startup"].mark_ready(engine.metrics)
+
+        async def start_fake_bring_up(app):
+            app["bringup_task"] = asyncio.create_task(fake_bring_up(app))
+
+        app.on_startup.clear()
+        app.on_startup.append(start_fake_bring_up)
+        async with TestClient(TestServer(app)) as client:
+            startup = await client.get("/startupz")
+            assert startup.status == 503
+            assert (await startup.json())["state"] == "loading"
+            health = await client.get("/healthz")
+            assert health.status == 503
+            live = await client.get("/livez")
+            assert live.status == 200
+            shed = await client.post("/detect", json={"image_urls": ["http://x/y.jpg"]})
+            assert shed.status == 503
+            assert "Retry-After" in shed.headers
+            metrics = await client.get("/metrics")
+            assert (await metrics.json())["startup"]["state"] == "loading"
+
+            monkeypatch_release.set()
+            for _ in range(100):
+                startup = await client.get("/startupz")
+                if startup.status == 200:
+                    break
+                await asyncio.sleep(0.01)
+            assert startup.status == 200
+            ok = await client.post("/detect", json={"image_urls": ["http://x/y.jpg"]})
+            assert ok.status == 200
+            await app["detector"].batcher.stop()
+
+    asyncio.run(run(asyncio.Event()))
+
+
+# ---- preemption watcher ----
+
+
+def test_preemption_file_source_drains_and_exits(tmp_path):
+    """The maintenance-file source: file appears -> readiness flips via
+    drain() -> distinct exit code handed to exit_cb. No SIGTERM involved."""
+    detector, engine = _detector()
+    marker = tmp_path / "preempt-now"
+    exit_codes = []
+
+    async def run():
+        watcher = lifecycle.PreemptionWatcher(
+            on_preempt=detector.drain,
+            poll_s=0.02,
+            file_source=str(marker),
+            url_source=None,
+            exit_cb=exit_codes.append,
+            install_sigterm=False,
+        )
+        await watcher.start()
+        await asyncio.sleep(0.1)
+        assert not watcher.preempted  # no event yet
+        marker.write_text("maintenance")
+        for _ in range(200):
+            if exit_codes:
+                break
+            await asyncio.sleep(0.01)
+        assert exit_codes == [lifecycle.PREEMPTED_EXIT_CODE]
+        assert watcher.preempted and "maintenance file" in watcher.reason
+        assert detector.batcher.draining  # drain actually ran
+        await watcher.stop()
+
+    asyncio.run(run())
+    assert engine.metrics.snapshot()["draining"] is True
+
+
+def test_preemption_trigger_is_idempotent():
+    drains = []
+
+    async def run():
+        async def on_preempt():
+            drains.append(1)
+
+        exit_codes = []
+        watcher = lifecycle.PreemptionWatcher(
+            on_preempt=on_preempt,
+            poll_s=0.02,
+            file_source=None,
+            url_source=None,
+            exit_cb=exit_codes.append,
+            install_sigterm=False,
+        )
+        await watcher.start()
+        watcher.trigger("SIGTERM")
+        watcher.trigger("SIGTERM again")  # must not double-drain
+        for _ in range(100):
+            if exit_codes:
+                break
+            await asyncio.sleep(0.01)
+        assert drains == [1]
+        assert exit_codes == [lifecycle.PREEMPTED_EXIT_CODE]
+        assert watcher.reason == "SIGTERM"
+        await watcher.stop()
+
+    asyncio.run(run())
+
+
+# ---- warm restart plumbing ----
+
+
+def test_compile_cache_env(monkeypatch, tmp_path):
+    cache_dir = tmp_path / "compile-cache"
+    monkeypatch.setenv(lifecycle.COMPILE_CACHE_ENV, str(cache_dir))
+    assert lifecycle.maybe_enable_compile_cache() == str(cache_dir)
+    assert cache_dir.is_dir()
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+
+    monkeypatch.delenv(lifecycle.COMPILE_CACHE_ENV)
+    assert lifecycle.maybe_enable_compile_cache() is None
+
+
+def test_restarts_from_env(monkeypatch):
+    monkeypatch.delenv(lifecycle.RESTARTS_ENV, raising=False)
+    assert lifecycle.restarts_from_env() == 0
+    monkeypatch.setenv(lifecycle.RESTARTS_ENV, "3")
+    assert lifecycle.restarts_from_env() == 3
+    monkeypatch.setenv(lifecycle.RESTARTS_ENV, "garbage")
+    assert lifecycle.restarts_from_env() == 0
+
+
+# ---- admin-token guard ----
+
+
+def test_admin_endpoints_open_when_token_unset(monkeypatch):
+    monkeypatch.delenv(ADMIN_TOKEN_ENV, raising=False)
+    detector, _ = _detector()
+
+    async def run():
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            drained = await client.post("/drain")
+            assert drained.status == 200
+
+    asyncio.run(run())
+
+
+def test_admin_endpoints_guarded_when_token_set(monkeypatch):
+    monkeypatch.setenv(ADMIN_TOKEN_ENV, "s3cret")
+    detector, _ = _detector()
+
+    async def run():
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            # missing and wrong tokens are rejected before any state changes
+            no_token = await client.post("/drain")
+            assert no_token.status == 401
+            wrong = await client.post("/drain", headers={ADMIN_TOKEN_HEADER: "nope"})
+            assert wrong.status == 401
+            profile_no_token = await client.post("/profile", json={})
+            assert profile_no_token.status == 401
+            # the replica kept serving: the failed drains changed nothing
+            health = await client.get("/healthz")
+            assert health.status == 200
+            # correct token drains
+            ok = await client.post("/drain", headers={ADMIN_TOKEN_HEADER: "s3cret"})
+            assert ok.status == 200
+            assert (await ok.json())["status"] == "drained"
+
+    asyncio.run(run())
+
+
+# ---- /metrics lifecycle fields across drain/restart ----
+
+
+def test_metrics_lifecycle_fields_survive_drain_restart(monkeypatch):
+    """time_to_ready_s and restarts_total are process-lifetime gauges: a
+    batcher drain + restart (the in-process analog of readiness flapping)
+    must not reset them."""
+    monkeypatch.setenv(lifecycle.RESTARTS_ENV, "2")
+    detector, engine = _detector()
+
+    async def run():
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            snap = await (await client.get("/metrics")).json()
+            assert snap["time_to_ready_s"] > 0
+            assert snap["restarts_total"] == 2
+
+            await client.post("/drain")
+            snap_drained = await (await client.get("/metrics")).json()
+            assert snap_drained["draining"] is True
+            assert snap_drained["time_to_ready_s"] == snap["time_to_ready_s"]
+            assert snap_drained["restarts_total"] == 2
+
+            # explicit re-open (the supervisor-restart analog inside one
+            # process) keeps the gauges
+            await detector.batcher.start()
+            ok = await client.post("/detect", json={"image_urls": ["http://x/a.jpg"]})
+            assert ok.status == 200
+            snap_restarted = await (await client.get("/metrics")).json()
+            assert snap_restarted["draining"] is False
+            assert snap_restarted["time_to_ready_s"] == snap["time_to_ready_s"]
+            assert snap_restarted["restarts_total"] == 2
+
+    asyncio.run(run())
+
+
+# ---- multihost coordinator timeout (satellite) ----
+
+
+def test_coordinator_timeout_default_and_env(monkeypatch):
+    from spotter_tpu.parallel import multihost
+
+    monkeypatch.delenv(multihost.COORD_TIMEOUT_ENV, raising=False)
+    assert multihost.coordinator_timeout_s() == multihost.DEFAULT_COORD_TIMEOUT_S
+    monkeypatch.setenv(multihost.COORD_TIMEOUT_ENV, "45")
+    assert multihost.coordinator_timeout_s() == 45
+    assert multihost.multihost_env_summary()["SPOTTER_TPU_COORD_TIMEOUT_S"] == "45"
+    for bad in ("abc", "0", "-5"):
+        monkeypatch.setenv(multihost.COORD_TIMEOUT_ENV, bad)
+        with pytest.raises(ValueError):
+            multihost.coordinator_timeout_s()
+
+
+def test_initialize_passes_timeout_to_jax(monkeypatch):
+    """The env knob must actually reach jax.distributed.initialize as
+    initialization_timeout — the whole point is failing fast on a dead
+    coordinator."""
+    import jax
+
+    from spotter_tpu.parallel import multihost
+
+    captured = {}
+
+    def fake_initialize(**kwargs):
+        captured.update(kwargs)
+
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv(multihost.COORD_TIMEOUT_ENV, "17")
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    monkeypatch.setattr(multihost, "_distributed_is_initialized", lambda: False)
+    assert multihost.initialize_multihost() is True
+    assert captured["initialization_timeout"] == 17
+    assert captured["num_processes"] == 2
+    assert captured["process_id"] == 0
+
+
+def test_initialize_wraps_coordinator_failure(monkeypatch):
+    import jax
+
+    from spotter_tpu.parallel import multihost
+
+    def exploding_initialize(**kwargs):
+        raise RuntimeError("DEADLINE_EXCEEDED: connect to coordinator")
+
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setattr(jax.distributed, "initialize", exploding_initialize)
+    monkeypatch.setattr(multihost, "_distributed_is_initialized", lambda: False)
+    with pytest.raises(RuntimeError, match="multihost bring-up failed"):
+        multihost.initialize_multihost()
+
+
+def test_time_to_ready_anchor_is_monotonic():
+    # _PROCESS_START is captured at module import; mark_ready measured from
+    # it must be >= any tracker's own age
+    tracker = lifecycle.StartupTracker()
+    time.sleep(0.01)
+    ttr = tracker.mark_ready()
+    assert ttr >= 0.01
+    assert tracker.snapshot()["time_to_ready_s"] == ttr
+
+
+def test_stub_engine_detects_and_records_metrics():
+    engine = StubEngine(service_ms=1.0)
+    out = engine.detect([object(), object()])
+    assert len(out) == 2 and out[0][0]["label"] == "tv"
+    snap = engine.metrics.snapshot()
+    assert snap["images_total"] == 2
+
+
+# ---- supervisor policy (in-process; the cross-process path is in
+# tests/test_failover.py) ----
+
+
+def test_supervisor_crash_loop_circuit():
+    import sys
+
+    from spotter_tpu.serving.supervisor import CRASH_LOOP_EXIT_CODE, Supervisor
+
+    sup = Supervisor(
+        [sys.executable, "-c", "import sys; sys.exit(1)"],
+        backoff_base_s=0.02,
+        backoff_max_s=0.05,
+        min_uptime_s=1.0,
+        crash_loop_limit=3,
+    )
+    assert sup.run() == CRASH_LOOP_EXIT_CODE
+    assert sup.restarts_total == 3  # circuit tripped before the 4th respawn
+
+
+def test_supervisor_clean_exit_propagates():
+    import sys
+
+    from spotter_tpu.serving.supervisor import Supervisor
+
+    sup = Supervisor([sys.executable, "-c", "pass"])
+    assert sup.run() == 0
+    assert sup.restarts_total == 0
+
+
+def test_supervisor_exports_restart_count_and_pidfile(tmp_path):
+    """Each spawn exports SPOTTER_TPU_RESTARTS and rewrites the pidfile —
+    the plumbing behind restarts_total in /metrics and behind harnesses
+    targeting the current child."""
+    import sys
+
+    from spotter_tpu.serving.supervisor import Supervisor
+
+    out = tmp_path / "restarts.log"
+    pidfile = tmp_path / "child.pid"
+    script = (
+        "import os, sys\n"
+        f"with open({str(out)!r}, 'a') as f:\n"
+        "    f.write(os.environ['SPOTTER_TPU_RESTARTS'] + '\\n')\n"
+        "sys.exit(0 if os.environ['SPOTTER_TPU_RESTARTS'] == '2' else 1)\n"
+    )
+    sup = Supervisor(
+        [sys.executable, "-c", script],
+        backoff_base_s=0.02,
+        backoff_max_s=0.05,
+        min_uptime_s=1.0,
+        crash_loop_limit=10,
+        pidfile=str(pidfile),
+    )
+    assert sup.run() == 0  # third generation (RESTARTS=2) exits cleanly
+    assert out.read_text().split() == ["0", "1", "2"]
+    assert pidfile.exists() and int(pidfile.read_text()) > 0
+
+
+@pytest.mark.skipif(os.name != "posix", reason="posix-only")
+def test_preemption_env_source_construction(monkeypatch, tmp_path):
+    """Env-driven construction: file/url/poll knobs are read when the
+    constructor args are left at None."""
+    monkeypatch.setenv(lifecycle.PREEMPTION_FILE_ENV, str(tmp_path / "m"))
+    monkeypatch.setenv(lifecycle.PREEMPTION_POLL_ENV, "0.5")
+    monkeypatch.delenv(lifecycle.PREEMPTION_URL_ENV, raising=False)
+
+    async def noop():
+        pass
+
+    watcher = lifecycle.PreemptionWatcher(on_preempt=noop, install_sigterm=False)
+    assert watcher.file_source == str(tmp_path / "m")
+    assert watcher.url_source is None
+    assert watcher.poll_s == 0.5

@@ -1,0 +1,275 @@
+"""ReplicaPool unit tests (ISSUE 2): health-aware selection, replay on
+transport errors and replayable statuses, outlier ejection with exponential
+backoff + health-loop recovery, hedging, and the counters snapshot. Replicas
+here are tiny in-process aiohttp servers with scriptable behavior — the
+subprocess/chaos version lives in tests/test_failover.py."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from spotter_tpu.serving.replica_pool import PoolExhaustedError, ReplicaPool
+
+PAYLOAD = {"image_urls": ["http://example.com/room.jpg"]}
+
+
+class ScriptedReplica:
+    """In-process /detect + /healthz server whose behavior mutates mid-test."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.status = 200
+        self.delay_s = 0.0
+        self.health_status = 200
+        self.detect_calls = 0
+        app = web.Application()
+        app.router.add_post("/detect", self._detect)
+        app.router.add_get("/healthz", self._healthz)
+        self.server = TestServer(app)
+
+    async def _detect(self, request: web.Request) -> web.Response:
+        self.detect_calls += 1
+        if self.delay_s:
+            await asyncio.sleep(self.delay_s)
+        return web.json_response({"served_by": self.name}, status=self.status)
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({}, status=self.health_status)
+
+    async def start(self) -> str:
+        await self.server.start_server()
+        return f"http://{self.server.host}:{self.server.port}"
+
+    async def stop(self) -> None:
+        await self.server.close()
+
+
+async def _with_replicas(n):
+    replicas = [ScriptedReplica(f"r{i}") for i in range(n)]
+    urls = [await r.start() for r in replicas]
+    return replicas, urls
+
+
+def test_round_robin_spreads_load():
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        pool = ReplicaPool(urls, health_interval_s=0.05)
+        for _ in range(8):
+            body = await pool.detect(PAYLOAD)
+            assert body["served_by"] in ("r0", "r1")
+        assert replicas[0].detect_calls > 0 and replicas[1].detect_calls > 0
+        assert pool.requests_total == 8 and pool.failures_total == 0
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_replay_on_dead_replica_and_ejection():
+    """A dead endpoint (connection refused — the preemption signature) must
+    be invisible to the client: every request replays to the survivor, and
+    after eject_threshold consecutive failures the dead replica is ejected
+    so later requests stop paying the connect attempt."""
+
+    async def run():
+        replicas, urls = await _with_replicas(1)
+        dead = "http://127.0.0.1:1"  # reserved port: connect refused
+        pool = ReplicaPool(
+            [dead, urls[0]],
+            eject_threshold=2,
+            backoff_base_s=5.0,  # long: must not un-eject mid-test
+            health_interval_s=30.0,
+        )
+        for _ in range(6):
+            body = await pool.detect(PAYLOAD)
+            assert body["served_by"] == "r0"
+        assert pool.replays_total >= 1
+        assert pool.ejections_total >= 1
+        snap = pool.snapshot()
+        dead_snap = next(r for r in snap["replicas"] if r["url"] == dead)
+        assert not dead_snap["available"]
+        assert dead_snap["ejected_for_s"] > 0
+        # once ejected, new requests go straight to the survivor
+        calls_before = replicas[0].detect_calls
+        await pool.detect(PAYLOAD)
+        assert replicas[0].detect_calls == calls_before + 1
+        assert pool.failures_total == 0  # nothing client-visible
+        await pool.stop()
+        await replicas[0].stop()
+
+    asyncio.run(run())
+
+
+def test_replay_on_503_draining_replica():
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        replicas[0].status = 503  # draining / breaker open
+        pool = ReplicaPool(urls, health_interval_s=30.0)
+        for _ in range(4):
+            body = await pool.detect(PAYLOAD)
+            assert body["served_by"] == "r1"
+        assert pool.replays_total >= 1
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_health_loop_unejects_recovered_replica():
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        replicas[0].status = 500
+        replicas[0].health_status = 503
+        pool = ReplicaPool(
+            urls,
+            eject_threshold=1,
+            backoff_base_s=0.05,
+            backoff_max_s=0.1,
+            health_interval_s=0.05,
+        )
+        await pool.start()
+        await pool.detect(PAYLOAD)  # trips the ejection on r0 (or serves r1)
+        await pool.detect(PAYLOAD)
+        assert pool.ejections_total >= 1
+        # recover r0: health loop should reset it within a few intervals
+        replicas[0].status = 200
+        replicas[0].health_status = 200
+        for _ in range(100):
+            r0 = pool.replicas[0]
+            if r0.healthy and r0.consecutive_failures == 0 and r0.ejected_until == 0.0:
+                break
+            await asyncio.sleep(0.02)
+        assert pool.replicas[0].healthy
+        assert pool.replicas[0].consecutive_failures == 0
+        served = set()
+        for _ in range(8):
+            served.add((await pool.detect(PAYLOAD))["served_by"])
+        assert "r0" in served  # actually taking traffic again
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_hedging_wins_on_slow_replica():
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        replicas[0].delay_s = 1.0  # alive but drowning
+        pool = ReplicaPool(urls, hedge_after_s=0.05, health_interval_s=30.0)
+        t0 = asyncio.get_running_loop().time()
+        # r0 and r1 alternate as primary; when slow r0 is primary the hedge
+        # fires and r1's answer wins
+        bodies = [await pool.detect(PAYLOAD) for _ in range(2)]
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert all(b["served_by"] == "r1" for b in bodies)
+        assert elapsed < 1.0  # never waited out the slow replica
+        assert pool.hedges_total >= 1
+        assert pool.hedge_wins_total >= 1
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_pool_exhausted_is_loud():
+    async def run():
+        pool = ReplicaPool(
+            ["http://127.0.0.1:1", "http://127.0.0.1:2"],
+            health_interval_s=30.0,
+        )
+        with pytest.raises(PoolExhaustedError):
+            await pool.detect(PAYLOAD)
+        assert pool.failures_total == 1
+        snap = pool.snapshot()
+        assert snap["pool_failures_total"] == 1
+        assert snap["pool_requests_total"] == 1
+        await pool.stop()
+
+    asyncio.run(run())
+
+
+def test_snapshot_counter_fields():
+    async def run():
+        replicas, urls = await _with_replicas(1)
+        pool = ReplicaPool(urls, health_interval_s=30.0)
+        await pool.detect(PAYLOAD)
+        snap = pool.snapshot()
+        for key in (
+            "pool_requests_total",
+            "pool_replays_total",
+            "pool_hedges_total",
+            "pool_hedge_wins_total",
+            "pool_ejections_total",
+            "pool_failures_total",
+            "replicas",
+        ):
+            assert key in snap
+        (r,) = snap["replicas"]
+        assert r["requests"] == 1 and r["healthy"] and r["available"]
+        await pool.stop()
+        await replicas[0].stop()
+
+    asyncio.run(run())
+
+
+def test_router_app_routes():
+    """The edge router surface: /detect forwarded, /healthz reflects pool
+    availability, /metrics serves the pool snapshot."""
+    from aiohttp.test_utils import TestClient
+
+    from spotter_tpu.serving.router import make_router_app
+
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        pool = ReplicaPool(urls, health_interval_s=0.1)
+        app = make_router_app(pool)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post("/detect", json=PAYLOAD)
+            assert resp.status == 200
+            assert (await resp.json())["served_by"] in ("r0", "r1")
+
+            health = await client.get("/healthz")
+            assert health.status == 200
+            body = await health.json()
+            assert body["available_replicas"] == 2
+
+            live = await client.get("/livez")
+            assert live.status == 200
+
+            metrics = await (await client.get("/metrics")).json()
+            assert metrics["pool_requests_total"] == 1
+            assert len(metrics["replicas"]) == 2
+
+            bad = await client.post("/detect", data=b"{nope")
+            assert bad.status == 400
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_router_503_when_pool_exhausted():
+    from aiohttp.test_utils import TestClient
+
+    from spotter_tpu.serving.router import make_router_app
+
+    async def run():
+        pool = ReplicaPool(["http://127.0.0.1:1"], health_interval_s=30.0)
+        app = make_router_app(pool)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post("/detect", json=PAYLOAD)
+            assert resp.status == 503
+            assert "Retry-After" in resp.headers
+
+    asyncio.run(run())
+
+
+def test_pool_requires_endpoints():
+    with pytest.raises(ValueError):
+        ReplicaPool([])
