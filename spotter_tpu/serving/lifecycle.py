@@ -48,10 +48,19 @@ DEFAULT_PREEMPTION_POLL_S = 5.0
 # instead of treating it as a crash loop.
 PREEMPTED_EXIT_CODE = 83
 
-# Startup states, in order. "ready" is terminal for a healthy bring-up.
+# Startup states, in order. "ready" is terminal for a healthy bring-up;
+# "failed" is terminal for a bring-up that raised — the server exits
+# non-zero right after marking it so the supervisor/kubelet restart path
+# (with backoff) takes over instead of the replica serving 503s forever.
 LOADING = "loading"
 WARMING = "warming"
 READY = "ready"
+FAILED = "failed"
+
+# Exit code for a failed bring-up: distinct from PREEMPTED_EXIT_CODE (83)
+# and the supervisor's CRASH_LOOP_EXIT_CODE (84) so logs tell the three
+# apart; the supervisor treats it as a plain crash (exponential backoff).
+BRINGUP_FAILED_EXIT_CODE = 82
 
 # Process-start anchor for time_to_ready_s. Module import happens at the top
 # of server bootstrap, so this slightly undercounts interpreter start — the
@@ -101,6 +110,7 @@ class StartupTracker:
         self._state = LOADING
         self._since = time.monotonic()
         self.time_to_ready_s: Optional[float] = None
+        self.error: Optional[str] = None
 
     @property
     def state(self) -> str:
@@ -126,12 +136,20 @@ class StartupTracker:
             metrics.set_time_to_ready(self.time_to_ready_s)
         return self.time_to_ready_s
 
+    def mark_failed(self, error: str) -> None:
+        """Terminal: bring-up raised. /startupz keeps answering 503 with the
+        error for whatever probe window remains before the process exits."""
+        self._state = FAILED
+        self._since = time.monotonic()
+        self.error = error
+
     def snapshot(self) -> dict:
         return {
             "state": self._state,
             "ready": self.ready,
             "state_age_s": time.monotonic() - self._since,
             "time_to_ready_s": self.time_to_ready_s,
+            "error": self.error,
         }
 
 

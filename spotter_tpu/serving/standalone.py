@@ -110,6 +110,7 @@ def make_app(
     model_name: str | None = None,
     warmup: bool = False,
     preemption: bool = False,
+    bringup_exit_cb=os._exit,
 ) -> web.Application:
     """Build the serving app.
 
@@ -117,6 +118,12 @@ def make_app(
     bring-up runs as a background task after the HTTP surface binds: the
     startupProbe watches /startupz while the model loads and warms.
     `preemption=True` (the `main()` path) installs the PreemptionWatcher.
+
+    A FAILED bring-up (bad MODEL_NAME, OOM, compile error) must not leave
+    the process alive serving 503s forever — the supervisor/kubelet only
+    react to process exit. It marks the terminal `failed` startup state and
+    calls `bringup_exit_cb(BRINGUP_FAILED_EXIT_CODE)` (default `os._exit`,
+    overridable in tests) so the crash-loop/backoff machinery takes over.
     """
     app = web.Application(client_max_size=64 * 1024 * 1024)
     tracker = lifecycle.StartupTracker()
@@ -145,9 +152,13 @@ def make_app(
             det.engine.metrics.set_restarts(lifecycle.restarts_from_env())
             ttr = tracker.mark_ready(det.engine.metrics)
             logger.info("replica ready in %.1f s", ttr)
-        except Exception:
-            logger.exception("replica bring-up failed")
+        except asyncio.CancelledError:  # server shutdown mid-bring-up
             raise
+        except Exception as exc:
+            logger.exception("replica bring-up failed; exiting %d",
+                             lifecycle.BRINGUP_FAILED_EXIT_CODE)
+            tracker.mark_failed(f"{type(exc).__name__}: {exc}")
+            bringup_exit_cb(lifecycle.BRINGUP_FAILED_EXIT_CODE)
 
     async def on_startup(app: web.Application) -> None:
         # profiler server after the loop exists; tasks stored for cleanup

@@ -8,7 +8,11 @@ NOT hot-looping when the server crashes at import time. Policy:
 - exit 0 (operator stop) → supervisor exits 0;
 - `PREEMPTED_EXIT_CODE` (drained preemption exit, serving/lifecycle.py) →
   immediate restart, backoff reset: the replica told us it shut down
-  healthy;
+  healthy. But a preemption SOURCE can outlive the child (the maintenance
+  file is not deleted, a GCE maintenance window spans minutes), so only the
+  first `--preempt-fast` consecutive sub-min-uptime preemption exits restart
+  for free — after that the normal exponential backoff applies so the pair
+  cannot hot-loop spawn→drain→exit;
 - any other exit → restart after exponential backoff (`--backoff-base`,
   doubling to `--backoff-max`); a child that stayed up ≥ `--min-uptime`
   resets the backoff;
@@ -28,6 +32,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 from spotter_tpu.serving.lifecycle import PREEMPTED_EXIT_CODE, RESTARTS_ENV
@@ -38,6 +43,7 @@ DEFAULT_BACKOFF_BASE_S = 0.5
 DEFAULT_BACKOFF_MAX_S = 30.0
 DEFAULT_MIN_UPTIME_S = 5.0
 DEFAULT_CRASH_LOOP_LIMIT = 5
+DEFAULT_PREEMPT_FAST_LIMIT = 3
 CRASH_LOOP_EXIT_CODE = 84  # distinct from the child's codes and from 83
 
 
@@ -49,6 +55,7 @@ class Supervisor:
         backoff_max_s: float = DEFAULT_BACKOFF_MAX_S,
         min_uptime_s: float = DEFAULT_MIN_UPTIME_S,
         crash_loop_limit: int = DEFAULT_CRASH_LOOP_LIMIT,
+        preempt_fast_limit: int = DEFAULT_PREEMPT_FAST_LIMIT,
         pidfile: str | None = None,
     ) -> None:
         if not cmd:
@@ -58,10 +65,15 @@ class Supervisor:
         self.backoff_max_s = backoff_max_s
         self.min_uptime_s = min_uptime_s
         self.crash_loop_limit = crash_loop_limit
+        self.preempt_fast_limit = preempt_fast_limit
         self.pidfile = pidfile
         self.restarts_total = 0
         self.child: subprocess.Popen | None = None
         self._terminating = False
+        # Set by _forward_term so the backoff wait wakes immediately instead
+        # of time.sleep resuming after the handler (PEP 475) and the loop
+        # spawning a child nobody asked for.
+        self._term_event = threading.Event()
 
     def _spawn(self) -> subprocess.Popen:
         env = dict(os.environ)
@@ -80,6 +92,7 @@ class Supervisor:
 
     def _forward_term(self, signum, frame) -> None:
         self._terminating = True
+        self._term_event.set()
         if self.child is not None and self.child.poll() is None:
             self.child.send_signal(signal.SIGTERM)
 
@@ -89,9 +102,21 @@ class Supervisor:
         signal.signal(signal.SIGTERM, self._forward_term)
         backoff = 0.0
         consecutive_fast_crashes = 0
+        consecutive_fast_preempts = 0
+        code = 0
         while True:
+            if self._terminating:
+                # SIGTERM landed while no child was running (e.g. during the
+                # backoff wait): do NOT spawn a replacement the signal could
+                # never reach — propagate the last child's code.
+                logger.info("terminated between children; exiting %d", code)
+                return code
             started = time.monotonic()
             self.child = self._spawn()
+            if self._terminating and self.child.poll() is None:
+                # signal raced the spawn: the handler ran before self.child
+                # pointed at this child, so forward SIGTERM ourselves
+                self.child.send_signal(signal.SIGTERM)
             code = self.child.wait()
             uptime = time.monotonic() - started
             if self._terminating:
@@ -102,11 +127,35 @@ class Supervisor:
                 return 0
             if code == PREEMPTED_EXIT_CODE:
                 # drained preemption: the replica is healthy software on
-                # yanked capacity — restart immediately, no backoff debt
-                logger.warning("child preempted (exit %d); immediate warm restart", code)
-                backoff = 0.0
+                # yanked capacity — restart immediately, no backoff debt. But
+                # the source can persist (the maintenance file is never
+                # deleted, a GCE window spans minutes), so only the first
+                # `preempt_fast_limit` consecutive sub-min-uptime preemption
+                # exits restart for free; after that, normal backoff.
                 consecutive_fast_crashes = 0
+                if uptime >= self.min_uptime_s:
+                    consecutive_fast_preempts = 0
+                else:
+                    consecutive_fast_preempts += 1
+                if consecutive_fast_preempts <= self.preempt_fast_limit:
+                    logger.warning(
+                        "child preempted (exit %d); immediate warm restart", code
+                    )
+                    backoff = 0.0
+                else:
+                    backoff = min(
+                        max(backoff * 2.0, self.backoff_base_s), self.backoff_max_s
+                    )
+                    logger.warning(
+                        "child preempted (exit %d) %d times under %.1f s uptime "
+                        "— preemption source persists; restarting in %.2f s",
+                        code, consecutive_fast_preempts, self.min_uptime_s, backoff,
+                    )
+                    if self._term_event.wait(backoff):
+                        logger.info("terminated during backoff; exiting %d", code)
+                        return code
             else:
+                consecutive_fast_preempts = 0
                 if uptime >= self.min_uptime_s:
                     backoff = 0.0
                     consecutive_fast_crashes = 0
@@ -126,7 +175,9 @@ class Supervisor:
                     "child crashed (exit %d, uptime %.1f s); restarting in %.2f s",
                     code, uptime, backoff,
                 )
-                time.sleep(backoff)
+                if self._term_event.wait(backoff):
+                    logger.info("terminated during backoff; exiting %d", code)
+                    return code
             self.restarts_total += 1
 
 
@@ -139,6 +190,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--backoff-max", type=float, default=DEFAULT_BACKOFF_MAX_S)
     parser.add_argument("--min-uptime", type=float, default=DEFAULT_MIN_UPTIME_S)
     parser.add_argument("--crash-loop", type=int, default=DEFAULT_CRASH_LOOP_LIMIT)
+    parser.add_argument("--preempt-fast", type=int, default=DEFAULT_PREEMPT_FAST_LIMIT,
+                        help="consecutive sub-min-uptime preemption exits that "
+                        "restart immediately before normal backoff applies")
     parser.add_argument("--pidfile", default=None,
                         help="rewritten with the current child pid on every spawn")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
@@ -156,6 +210,7 @@ def main(argv: list[str] | None = None) -> int:
         backoff_max_s=args.backoff_max,
         min_uptime_s=args.min_uptime,
         crash_loop_limit=args.crash_loop,
+        preempt_fast_limit=args.preempt_fast,
         pidfile=args.pidfile,
     )
     return sup.run()
