@@ -71,6 +71,14 @@ class MicroBatcher:
         the watchdog."""
         self.engine = engine
         self.max_batch = max_batch or engine.batch_buckets[-1]
+        # Aggregate bucket sizing (ISSUE 3): under dp-sharded serving the
+        # engine ladder is aggregate (dp × per-chip bucket — serving/app.py
+        # scales it), so the pump fills all chips' worth of images before
+        # dispatching, under the SAME max_delay/deadline/shed semantics as
+        # single-chip serving: a sparse queue still dispatches a partial
+        # batch after max_delay rather than stalling for the full bucket.
+        # The gauge makes the fill target visible next to mean_batch_size.
+        engine.metrics.set_aggregate_bucket(self.max_batch)
         self.max_delay_s = max_delay_ms / 1000.0
         self.max_in_flight = max(1, max_in_flight)
         if max_queue is None:

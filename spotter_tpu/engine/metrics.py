@@ -39,6 +39,15 @@ class Metrics:
         # batcher — only a process death resets them.
         self._time_to_ready_s: float | None = None
         self._restarts_total = 0
+        # Ingest-pipeline observability (ISSUE 3): host->device transfer
+        # volume (the quantity SPOTTER_TPU_DEVICE_PREPROCESS exists to cut),
+        # how many images that volume staged (-> bytes/image), the decode
+        # pool's backlog, and the batcher's aggregate dispatch bucket
+        # (dp × per-chip bucket under dp-sharded serving).
+        self._h2d_bytes_total = 0
+        self._h2d_images_total = 0
+        self._decode_queue_depth = 0
+        self._aggregate_bucket = 0
 
     def record_batch(
         self,
@@ -91,6 +100,20 @@ class Metrics:
         with self._lock:
             self._draining = draining
 
+    def record_h2d_bytes(self, nbytes: int, n_images: int) -> None:
+        """One staged batch's host->device transfer volume."""
+        with self._lock:
+            self._h2d_bytes_total += nbytes
+            self._h2d_images_total += n_images
+
+    def set_decode_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._decode_queue_depth = depth
+
+    def set_aggregate_bucket(self, bucket: int) -> None:
+        with self._lock:
+            self._aggregate_bucket = bucket
+
     def set_time_to_ready(self, seconds: float) -> None:
         with self._lock:
             self._time_to_ready_s = seconds
@@ -116,14 +139,27 @@ class Metrics:
                     return 0.0
                 return lats[min(int(p * len(lats)), len(lats) - 1)]
 
-            stage_p50 = {}
+            # per-stage histograms (ISSUE 3): p50 alone hid tail behavior in
+            # the staging/device stages the new ingest pipeline splits out
+            stage_stats = {}
             for name, ring in self._stages.items():
                 vals = sorted(ring)
                 if vals:
-                    stage_p50[f"stage_{name}_ms_p50"] = vals[len(vals) // 2]
+                    for p, tag in ((0.50, "p50"), (0.90, "p90"), (0.99, "p99")):
+                        stage_stats[f"stage_{name}_ms_{tag}"] = vals[
+                            min(int(p * len(vals)), len(vals) - 1)
+                        ]
 
             return {
-                **stage_p50,
+                **stage_stats,
+                "h2d_bytes_total": self._h2d_bytes_total,
+                "h2d_bytes_per_image": (
+                    self._h2d_bytes_total / self._h2d_images_total
+                    if self._h2d_images_total
+                    else 0.0
+                ),
+                "decode_pool_queue_depth": self._decode_queue_depth,
+                "aggregate_bucket": self._aggregate_bucket,
                 "images_total": self._images_total,
                 "errors_total": self._errors_total,
                 "shed_total": self._shed_total,

@@ -289,7 +289,9 @@ class ConvNorm(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         pad = (self.kernel_size - 1) // 2 if self.padding is None else self.padding
-        if int8_wanted(x.shape[-1]):
+        # batch-aware: the small-batch guard (utils/quant.py INT8_MIN_BATCH)
+        # keeps the latency-SLO buckets bf16 — batch is static under jit
+        if int8_wanted(x.shape[-1], batch=x.shape[0]):
             # Quantized path (SPOTTER_TPU_INT8=1, utils/quant.py): int8 MXU
             # conv with the dequant feeding the same frozen-BN chain. The
             # kernel param is declared at nn.Conv's exact path/shape/init so
@@ -421,7 +423,10 @@ class QuantDense(nn.Module):
             (x.shape[-1], self.features),
             jnp.float32,
         )
-        if int8_dense_wanted(x.shape[-1]):
+        # batch is static under jit, so the small-batch guard (int8 regresses
+        # under-filled MXU batches — utils/quant.py INT8_MIN_BATCH) resolves
+        # per compiled bucket with no runtime branch
+        if int8_dense_wanted(x.shape[-1], batch=x.shape[0]):
             y = int8_dense(x, kernel, self.dtype)
         else:
             y = jnp.matmul(x.astype(self.dtype), kernel.astype(self.dtype))

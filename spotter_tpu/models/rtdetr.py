@@ -137,7 +137,7 @@ class RepVggBlock(nn.Module):
                 self.features, 1, x.shape[-1], self.eps, name="conv2"
             )()
             wf = w3.at[1:2, 1:2].add(w1)
-            if int8_wanted(x.shape[-1]):
+            if int8_wanted(x.shape[-1], batch=x.shape[0]):
                 # int8 MXU path on the already-fused kernel (utils/quant.py):
                 # these 384-ch 3x3 convs are the encoder's measured hot spot
                 # (tools/bench_int8_conv.py: 1.5-1.6x at 80^2/40^2)

@@ -11,7 +11,11 @@ Arrays are NHWC — the natural TPU/XLA convolution layout (torch parity tests
 transpose to NCHW at the boundary).
 """
 
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from functools import partial
 
 import numpy as np
 from PIL import Image
@@ -193,3 +197,160 @@ def batch_images(
         np.stack(masks),
         np.asarray(sizes, dtype=np.float32),
     )
+
+
+# --- uint8 zero-copy ingest + on-device preprocess (ISSUE 3) -----------------
+#
+# The host float path above ships (B, H, W, 3) float32 pixels plus a
+# (B, H, W) float32 mask per batch — 16 bytes/pixel of H2D traffic, with the
+# rescale/normalize arithmetic on a single host core. The uint8 path keeps
+# only decode + resize-to-bucket on the host (PIL releases the GIL, so a
+# DecodePool parallelizes it), ships 3 bytes/pixel of uint8 NHWC plus a
+# (B, 2) valid-region tensor, and runs rescale/normalize/mask inside the
+# SAME jit program as the model forward (`device_rescale_normalize`), where
+# XLA fuses it into the first conv's input chain. Gated by
+# SPOTTER_TPU_DEVICE_PREPROCESS in the engine; the float path stays for
+# parity testing (tests/test_device_preprocess.py).
+
+DECODE_WORKERS_ENV = "SPOTTER_TPU_DECODE_WORKERS"
+
+
+def device_preprocess_supported(spec: PreprocessSpec) -> bool:
+    """pad_square (OWLv2) rescales BEFORE its skimage-style warp, so its
+    host work is inherently float — only the fixed/shortest_edge families
+    can defer rescale/normalize to the device."""
+    return spec.mode in ("fixed", "shortest_edge")
+
+
+class DecodePool:
+    """Thread pool for host decode/resize (the only host work left under
+    device preprocess). PIL's resize and the numpy conversion release the
+    GIL, so threads scale until the memory bus does; workers default to
+    SPOTTER_TPU_DECODE_WORKERS or a core-count heuristic. `queue_depth()`
+    (submitted-but-unfinished items) feeds the /metrics gauge that shows
+    when decode — not the device — is the binding constraint."""
+
+    def __init__(self, workers: int | None = None) -> None:
+        if workers is None:
+            raw = os.environ.get(DECODE_WORKERS_ENV, "").strip()
+            workers = int(raw) if raw else min(8, max(2, (os.cpu_count() or 2) - 1))
+        self.workers = max(1, workers)
+        self._pool = (
+            ThreadPoolExecutor(
+                max_workers=self.workers, thread_name_prefix="spotter-decode"
+            )
+            if self.workers > 1
+            else None
+        )
+        self._pending = 0
+        self._lock = threading.Lock()
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def map(self, fn, items: list) -> list:
+        """Ordered map over the pool (serial for 1 worker / 1 item)."""
+        if self._pool is None or len(items) <= 1:
+            return [fn(item) for item in items]
+        with self._lock:
+            self._pending += len(items)
+
+        def run(item):
+            try:
+                return fn(item)
+            finally:
+                with self._lock:
+                    self._pending -= 1
+
+        return list(self._pool.map(run, items))
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+def decode_resize_uint8(
+    image: Image.Image, spec: PreprocessSpec
+) -> tuple[np.ndarray, tuple[int, int], tuple[int, int]]:
+    """PIL image -> (uint8 (H, W, 3) in the static bucket, valid (h, w), orig (h, w)).
+
+    Host half of the split preprocess: decode + resize only, same resample
+    filter and shortest-edge arithmetic as `preprocess_image` (golden parity
+    depends on them) — rescale/normalize/mask move to the device.
+    """
+    orig_hw = (image.height, image.width)
+    if spec.mode == "fixed":
+        th, tw = spec.size
+        resized = image.resize((tw, th), resample=spec.resample)
+        return np.asarray(resized, dtype=np.uint8), (th, tw), orig_hw
+    if spec.mode == "shortest_edge":
+        rh, rw = shortest_edge_size(orig_hw, spec.size[0], spec.size[1])
+        resized = image.resize((rw, rh), resample=spec.resample)
+        ph, pw = spec.input_hw
+        arr = np.zeros((ph, pw, 3), dtype=np.uint8)
+        arr[:rh, :rw] = np.asarray(resized, dtype=np.uint8)
+        return arr, (rh, rw), orig_hw
+    raise ValueError(f"device preprocess does not support mode: {spec.mode}")
+
+
+def batch_images_uint8(
+    images: list[Image.Image],
+    spec: PreprocessSpec,
+    pool: DecodePool | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stack uint8-decoded images -> (pixels (B,H,W,3) u8, valid (B,2) i32,
+    sizes (B,2) f32 [orig h,w])."""
+    decode = partial(decode_resize_uint8, spec=spec)
+    decoded = pool.map(decode, images) if pool is not None else [
+        decode(img) for img in images
+    ]
+    return (
+        np.stack([d[0] for d in decoded]),
+        np.asarray([d[1] for d in decoded], dtype=np.int32),
+        np.asarray([d[2] for d in decoded], dtype=np.float32),
+    )
+
+
+def batch_images_host(
+    images: list[Image.Image],
+    spec: PreprocessSpec,
+    pool: DecodePool | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """`batch_images` through the DecodePool: same float output, parallel
+    per-image host preprocess (the host path keeps the pool win too)."""
+    process = partial(preprocess_image, spec=spec)
+    done = pool.map(process, images) if pool is not None else [
+        process(img) for img in images
+    ]
+    return (
+        np.stack([p for p, _, _ in done]),
+        np.stack([m for _, m, _ in done]),
+        np.asarray([hw for _, _, hw in done], dtype=np.float32),
+    )
+
+
+def device_rescale_normalize(pixels_u8, valid_hw, spec: PreprocessSpec):
+    """Device half of the split preprocess (traced inside the forward jit).
+
+    uint8 NHWC + per-image valid (h, w) -> (float32 pixels, float32 mask),
+    matching `preprocess_image`'s output: rescale, normalize, then zero the
+    pad region (the torch DETR processor pads AFTER normalization, so pad
+    pixels must be exactly 0, not (0 - mean)/std). Fused into the forward
+    program, so the intermediate float tensor never exists in host memory
+    and the uint8 input buffer is donated.
+    """
+    import jax.numpy as jnp
+
+    x = pixels_u8.astype(jnp.float32) * spec.rescale_factor
+    if spec.mean is not None and spec.std is not None:
+        x = (x - jnp.asarray(spec.mean, dtype=jnp.float32)) / jnp.asarray(
+            spec.std, dtype=jnp.float32
+        )
+    b, h, w = pixels_u8.shape[:3]
+    if spec.mode == "fixed":
+        return x, jnp.ones((b, h, w), dtype=jnp.float32)
+    rows = jnp.arange(h, dtype=jnp.int32)[None, :] < valid_hw[:, :1]  # (B, H)
+    cols = jnp.arange(w, dtype=jnp.int32)[None, :] < valid_hw[:, 1:]  # (B, W)
+    mask = (rows[:, :, None] & cols[:, None, :]).astype(jnp.float32)
+    return x * mask[..., None], mask

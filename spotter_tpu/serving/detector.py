@@ -238,6 +238,11 @@ class AmenitiesDetector:
             "ready": ready,
             "breaker": breaker.state,
             "draining": draining,
+            # ingest/topology config (ISSUE 3): which serving shape this
+            # replica runs — dp width and whether preprocess is on-device —
+            # so a fleet rollout of the new pipeline is auditable per pod
+            "dp": getattr(self.engine, "dp", 1),
+            "device_preprocess": getattr(self.engine, "device_preprocess", False),
         }
 
     async def drain(self) -> dict:

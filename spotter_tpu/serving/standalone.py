@@ -39,6 +39,7 @@ import tempfile
 import pydantic
 from aiohttp import web
 
+from spotter_tpu.ops import preprocess
 from spotter_tpu.serving import lifecycle
 from spotter_tpu.serving.resilience import AdmissionError
 from spotter_tpu.testing import faults, stub_engine
@@ -311,6 +312,26 @@ def main() -> None:
     parser.add_argument("--model", default=None, help="overrides MODEL_NAME env")
     parser.add_argument("--no-warmup", action="store_true")
     parser.add_argument(
+        "--serve-dp",
+        default=None,
+        help="data-parallel serving width: shard batches over this many "
+        "local chips with aggregate bucket sizing (SPOTTER_TPU_SERVE_DP; "
+        "'all' = every local chip)",
+    )
+    parser.add_argument(
+        "--device-preprocess",
+        action="store_true",
+        help="uint8 ingest + on-device rescale/normalize "
+        "(SPOTTER_TPU_DEVICE_PREPROCESS=1): 4x less H2D traffic, decode-only "
+        "host work",
+    )
+    parser.add_argument(
+        "--decode-workers",
+        type=int,
+        default=None,
+        help=f"host decode/resize pool size ({preprocess.DECODE_WORKERS_ENV})",
+    )
+    parser.add_argument(
         "--stub-engine",
         action="store_true",
         help=f"canned-detection stub engine ({stub_engine.STUB_ENGINE_ENV}=1); "
@@ -320,6 +341,14 @@ def main() -> None:
     logging.basicConfig(level=logging.INFO)
     if args.stub_engine:
         os.environ[stub_engine.STUB_ENGINE_ENV] = "1"
+    # ingest/topology flags land in the env: bring-up (and any supervisor
+    # respawn of it) reads them there, so flag and env behave identically
+    if args.serve_dp is not None:
+        os.environ["SPOTTER_TPU_SERVE_DP"] = str(args.serve_dp)
+    if args.device_preprocess:
+        os.environ["SPOTTER_TPU_DEVICE_PREPROCESS"] = "1"
+    if args.decode_workers is not None:
+        os.environ[preprocess.DECODE_WORKERS_ENV] = str(args.decode_workers)
     web.run_app(
         make_app(
             model_name=args.model, warmup=not args.no_warmup, preemption=True

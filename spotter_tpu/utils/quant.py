@@ -45,8 +45,19 @@ INT8 = os.environ.get(INT8_ENV, "0").strip() != "0"
 # pass for no MXU win. Contraction dim (k*k*cin) must fill the MXU.
 INT8_MIN_CH = int(os.environ.get("SPOTTER_TPU_INT8_MIN_CH", "64"))
 
+# Batch floor (ISSUE 3): int8 REGRESSES small batches — R101 bucket 4
+# measured 33.0 vs 18.7 ms/call bf16 (BASELINE round 5): under-filled MXU
+# contractions make the quantize/dequant passes pure overhead. Batch is a
+# static shape under jit, so the guard resolves per compiled bucket: the
+# default `--int8` serving config quantizes the batch>=8 throughput buckets
+# and leaves the latency-SLO bucket (4) bf16. Floor of 1 disables the guard
+# (the CI golden gate runs batch 1 and pins quantized accuracy there).
+INT8_MIN_BATCH = int(os.environ.get("SPOTTER_TPU_INT8_MIN_BATCH", "8"))
 
-def int8_wanted(in_channels: int) -> bool:
+
+def int8_wanted(in_channels: int, batch: int | None = None) -> bool:
+    if batch is not None and batch < INT8_MIN_BATCH:
+        return False
     return INT8 and in_channels >= INT8_MIN_CH
 
 
@@ -60,10 +71,12 @@ def int8_wanted(in_channels: int) -> bool:
 INT8_DENSE = os.environ.get("SPOTTER_TPU_INT8_DENSE", "0").strip() != "0"
 
 
-def int8_dense_wanted(in_features: int) -> bool:
+def int8_dense_wanted(in_features: int, batch: int | None = None) -> bool:
     # "additionally": dense quantization is an extension OF the int8 mode,
     # never active without it (INT8_DENSE=1 alone is a no-op) — keeps
     # bench/serving labels and the golden-gate bisection truthful
+    if batch is not None and batch < INT8_MIN_BATCH:
+        return False
     return INT8 and INT8_DENSE and in_features >= INT8_MIN_CH
 
 

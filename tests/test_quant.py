@@ -21,6 +21,30 @@ from spotter_tpu.utils.quant import (
 )
 
 
+def test_int8_min_batch_guard(monkeypatch):
+    """SPOTTER_TPU_INT8_MIN_BATCH (ISSUE 3): int8 regresses under-filled MXU
+    batches (R101 bucket 4: 33.0 vs 18.7 ms/call — BASELINE round 5), so the
+    guard keeps buckets below the floor bf16 even with INT8=1. Batch is a
+    static jit shape, so the decision is per compiled bucket; batch=None
+    (non-serving callers) keeps the old behavior."""
+    from spotter_tpu.utils import quant
+
+    monkeypatch.setattr(quant, "INT8", True)
+    monkeypatch.setattr(quant, "INT8_DENSE", True)
+    monkeypatch.setattr(quant, "INT8_MIN_BATCH", 8)
+    assert quant.int8_wanted(128) and quant.int8_wanted(128, batch=None)
+    assert not quant.int8_wanted(128, batch=4)  # latency-SLO bucket stays bf16
+    assert quant.int8_wanted(128, batch=8)
+    assert quant.int8_wanted(128, batch=16)
+    assert not quant.int8_dense_wanted(128, batch=4)
+    assert quant.int8_dense_wanted(128, batch=8)
+    # floor of 1 disables the guard (the CI golden gate runs batch 1)
+    monkeypatch.setattr(quant, "INT8_MIN_BATCH", 1)
+    assert quant.int8_wanted(128, batch=1)
+    # channel floor still applies regardless of batch
+    assert not quant.int8_wanted(8, batch=16)
+
+
 def test_quantize_weight_per_channel_roundtrip():
     rng = np.random.default_rng(0)
     w = jnp.asarray(rng.standard_normal((3, 3, 32, 16)) * 0.1, jnp.float32)
@@ -173,6 +197,9 @@ print("BOX", float(jnp.abs(out["pred_boxes"]).mean()))
         **os.environ,
         "JAX_PLATFORMS": "cpu",
         "SPOTTER_TPU_INT8_MIN_CH": "8",
+        # the subprocess forward runs batch 1 — disable the small-batch
+        # guard so INT8=1 actually takes the quantized path under test
+        "SPOTTER_TPU_INT8_MIN_BATCH": "1",
     }
     env_base.pop("PALLAS_AXON_POOL_IPS", None)
     outs = {}

@@ -107,6 +107,105 @@ def test_sharded_serving_via_mesh_env(monkeypatch):
     asyncio.run(run())
 
 
+def test_serve_dp_env_aggregate_ladder(monkeypatch):
+    """SPOTTER_TPU_SERVE_DP=2 is the first-class dp-sharded serving config
+    (ISSUE 3): the ladder keeps per-chip semantics and is scaled to the
+    aggregate (batcher fills dp × per-chip bucket), the engine gets a dp=2
+    tp=1 mesh, and the /detect wire contract holds end-to-end."""
+
+    async def run():
+        monkeypatch.setenv("SPOTTER_TPU_SERVE_DP", "2")
+        from spotter_tpu.serving.app import build_detector_app
+
+        detector = build_detector_app(
+            model_name="PekingU/rtdetr_v2_r18vd",
+            threshold=0.0,
+            batch_buckets=(1, 2),
+            max_delay_ms=1.0,
+        )
+        assert detector.engine.mesh is not None
+        assert detector.engine.mesh.shape == {"dp": 2, "tp": 1}
+        assert detector.engine.dp == 2
+        assert detector.engine.batch_buckets == (2, 4)  # aggregate, not rounded
+        assert detector.batcher.max_batch == 4
+        health = detector.health()
+        assert health["dp"] == 2 and health["device_preprocess"] is False
+        detector.client = _client_returning_image()
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post(
+                "/detect",
+                json={"image_urls": [f"http://example.com/{i}.jpg" for i in range(3)]},
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert len(body["images"]) == 3
+            metrics = await client.get("/metrics")
+            snap = await metrics.json()
+            assert snap["aggregate_bucket"] == 4
+
+    asyncio.run(run())
+
+
+def test_explicit_mesh_wins_over_serve_dp(monkeypatch):
+    """Both knobs set: the expert SPOTTER_TPU_MESH spec is authoritative
+    (keeps its round-up semantics); SERVE_DP must not double-scale."""
+    monkeypatch.setenv("SPOTTER_TPU_SERVE_DP", "4")
+    monkeypatch.setenv("SPOTTER_TPU_MESH", "dp=2")
+    from spotter_tpu.serving.app import build_detector_app
+
+    detector = build_detector_app(
+        model_name="PekingU/rtdetr_v2_r18vd", threshold=0.0, batch_buckets=(1, 2)
+    )
+    assert detector.engine.mesh.shape == {"dp": 2, "tp": 1}
+    assert detector.engine.batch_buckets == (2,)  # round-up, not ×dp
+
+
+def test_serve_dp_env_parsing(monkeypatch):
+    from spotter_tpu.serving.app import serve_dp_from_env
+
+    monkeypatch.delenv("SPOTTER_TPU_SERVE_DP", raising=False)
+    assert serve_dp_from_env() == 1
+    monkeypatch.setenv("SPOTTER_TPU_SERVE_DP", "4")
+    assert serve_dp_from_env() == 4
+    monkeypatch.setenv("SPOTTER_TPU_SERVE_DP", "all")
+    assert serve_dp_from_env() >= 1
+    monkeypatch.setenv("SPOTTER_TPU_SERVE_DP", "two")
+    with pytest.raises(ValueError):
+        serve_dp_from_env()
+
+
+def test_metrics_expose_ingest_pipeline(monkeypatch):
+    """/metrics carries the new ingest observability (ISSUE 3):
+    h2d_bytes_total/bytes-per-image, decode_pool_queue_depth, and per-stage
+    staging/device histograms (p50/p90/p99), for both ingest modes."""
+
+    async def run():
+        monkeypatch.setenv("SPOTTER_TPU_DEVICE_PREPROCESS", "1")
+        built = build_detector("PekingU/rtdetr_v2_r18vd")
+        engine = InferenceEngine(built, threshold=0.0, batch_buckets=(1, 2))
+        assert engine.device_preprocess  # env knob armed the uint8 path
+        detector = AmenitiesDetector(
+            engine, MicroBatcher(engine, max_delay_ms=1.0), _client_returning_image()
+        )
+        assert detector.health()["device_preprocess"] is True
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post(
+                "/detect", json={"image_urls": ["http://example.com/room.jpg"]}
+            )
+            assert resp.status == 200
+            snap = await (await client.get("/metrics")).json()
+            assert snap["h2d_bytes_total"] > 0
+            assert snap["h2d_bytes_per_image"] > 0
+            assert "decode_pool_queue_depth" in snap
+            for stage in ("preprocess", "decode", "h2d", "device"):
+                for tag in ("p50", "p90", "p99"):
+                    assert f"stage_{stage}_ms_{tag}" in snap
+
+    asyncio.run(run())
+
+
 def test_batch_buckets_env_knob(monkeypatch):
     """SPOTTER_TPU_BATCH_BUCKETS applies the per-model ladder guidance
     (e.g. R18's measured batch-16 peak) without code changes; malformed
