@@ -388,6 +388,195 @@ def failover_bench(args) -> int:
     return 0 if error_rate == 0.0 and time_to_ready_s is not None else 1
 
 
+def chaos_serve_bench(args) -> int:
+    """Engine fault domain, measured not asserted (ISSUE 4): the REAL
+    engine + MicroBatcher under concurrent load through two injected
+    faults — a ~1% poison stream (every Nth image tagged) and a mid-run
+    dead shard under dp>1. The model is the tiny RT-DETR (the quantity
+    under test is the fault machinery, not the forward pass; CPU ok over
+    virtual devices). Reports goodput, p50/p99 of successful requests,
+    time-to-degraded (shard fault -> rebuilt engine serving again), and the
+    poison/error accounting — all as parsed JSON fields.
+    """
+    import os
+
+    # virtual devices for CPU runs: must land in XLA_FLAGS before the first
+    # jax import of this process
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={args.chaos_devices}"
+        ).strip()
+
+    import asyncio
+
+    import jax
+    from PIL import Image
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.engine.engine import BuiltDetector, InferenceEngine
+    from spotter_tpu.engine.errors import PoisonImageError
+    from spotter_tpu.models.rtdetr import RTDetrDetector
+    from spotter_tpu.models.zoo import tiny_rtdetr_config
+    from spotter_tpu.ops.preprocess import PreprocessSpec
+    from spotter_tpu.parallel.mesh import make_mesh
+    from spotter_tpu.testing import faults
+
+    cfg = tiny_rtdetr_config()
+    module = RTDetrDetector(cfg)
+    params = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, 64, 64, 3), np.float32)
+    )["params"]
+    built = BuiltDetector(
+        model_name="chaos-tiny",
+        module=module,
+        params=params,
+        preprocess_spec=PreprocessSpec(mode="fixed", size=(64, 64)),
+        postprocess="sigmoid_topk",
+        id2label=cfg.id2label_dict,
+        num_top_queries=10,
+    )
+    devs = jax.local_devices()
+    dp = min(args.chaos_devices, len(devs))
+    mesh = make_mesh(dp=dp, tp=1, devices=devs[:dp]) if dp > 1 else None
+    engine = InferenceEngine(
+        built,
+        threshold=0.0,
+        batch_buckets=tuple(b * max(dp, 1) for b in (1, 2, 4)),
+        mesh=mesh,
+    )
+    engine.warmup()
+    batcher = MicroBatcher(engine, max_delay_ms=5.0)
+
+    n_requests = args.chaos_requests
+    poison_every = max(args.chaos_poison_every, 1)
+    fault_after = n_requests // 2
+    rng = np.random.default_rng(0)
+    ok_lats: list[float] = []
+    counts = {"ok": 0, "poison_failed": 0, "other_failed": 0}
+    timeline = {"fault_at": None, "degraded_at": None}
+
+    async def drive() -> None:
+        done = {"n": 0}
+        issued = {"n": 0}
+
+        async def one() -> None:
+            i = issued["n"]
+            issued["n"] += 1
+            img = Image.fromarray(
+                rng.integers(0, 255, (48, 64, 3), dtype=np.uint8)
+            )
+            is_poison = (i + 1) % poison_every == 0
+            if is_poison:
+                faults.poison_image(img)
+            t0 = time.perf_counter()
+            try:
+                await batcher.submit(img)
+                ok_lats.append(time.perf_counter() - t0)
+                counts["ok"] += 1
+            except PoisonImageError:
+                counts["poison_failed"] += 1
+            except Exception:
+                counts["other_failed"] += 1
+            done["n"] += 1
+
+        async def worker() -> None:
+            while issued["n"] < n_requests:
+                await one()
+
+        async def inject_shard_fault(plan) -> None:
+            if dp <= 1:
+                return
+            while done["n"] < fault_after:
+                await asyncio.sleep(0.01)
+            plan.shard_dead = devs[dp - 1].id
+            timeline["fault_at"] = time.monotonic()
+
+        async def watch_degraded() -> None:
+            if dp <= 1:
+                return
+            while timeline["fault_at"] is None:
+                await asyncio.sleep(0.01)
+            while engine.generation == 0:
+                await asyncio.sleep(0.02)
+            timeline["degraded_at"] = time.monotonic()
+
+        with faults.inject(poison_item=1) as plan:
+            watcher = asyncio.create_task(watch_degraded())
+            t_start = time.monotonic()
+            await asyncio.gather(
+                inject_shard_fault(plan),
+                *(worker() for _ in range(args.chaos_concurrency)),
+            )
+            # keep a trickle flowing until the degraded rebuild is observed
+            deadline = time.monotonic() + 120.0
+            while (
+                dp > 1
+                and timeline["degraded_at"] is None
+                and time.monotonic() < deadline
+            ):
+                await one()
+                await asyncio.sleep(0.02)
+            timeline["elapsed_s"] = time.monotonic() - t_start
+            watcher.cancel()
+            await batcher.stop()
+
+    asyncio.run(drive())
+
+    total = counts["ok"] + counts["poison_failed"] + counts["other_failed"]
+    goodput = counts["ok"] / timeline["elapsed_s"] if timeline.get("elapsed_s") else 0.0
+    t_fault, t_degraded = timeline["fault_at"], timeline["degraded_at"]
+    time_to_degraded_s = (
+        (t_degraded - t_fault) if (t_fault and t_degraded) else None
+    )
+    p50_ms = float(np.median(ok_lats)) * 1e3 if ok_lats else None
+    p99_ms = float(np.percentile(ok_lats, 99)) * 1e3 if ok_lats else None
+    snap = engine.metrics.snapshot()
+    print(
+        f"# chaos-serve dp={dp}: {total} requests, {counts['ok']} ok "
+        f"({goodput:.1f} img/s goodput), {counts['poison_failed']} poison-"
+        f"failed (isolated {snap['poison_isolated_total']}), "
+        f"{counts['other_failed']} other failures (shard-loss window); "
+        f"p50 {_fmt(p50_ms, '.1f')} ms / p99 {_fmt(p99_ms, '.1f')} ms; "
+        f"time-to-degraded {_fmt(time_to_degraded_s, '.2f')} s "
+        f"(rebuilds {snap['engine_rebuilds_total']}, dp_degraded "
+        f"{snap['dp_degraded']})",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"chaos-serve goodput (dp={dp}, 1/{poison_every} poison stream + "
+            f"mid-run shard loss; time-to-degraded "
+            f"{_fmt(time_to_degraded_s, '.2f')} s, p99 {_fmt(p99_ms, '.1f')} ms)"
+        ),
+        "value": round(goodput, 1),
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "dp": dp,
+        "requests_total": total,
+        "ok": counts["ok"],
+        "goodput_ips": round(goodput, 1),
+        "p50_ms": None if p50_ms is None else round(p50_ms, 2),
+        "p99_ms": None if p99_ms is None else round(p99_ms, 2),
+        "poison_injected_failures": counts["poison_failed"],
+        "poison_isolated_total": snap["poison_isolated_total"],
+        "batch_retries_total": snap["batch_retries_total"],
+        "other_failures": counts["other_failed"],
+        "time_to_degraded_s": (
+            None if time_to_degraded_s is None else round(time_to_degraded_s, 3)
+        ),
+        "engine_rebuilds_total": snap["engine_rebuilds_total"],
+        "dp_degraded": snap["dp_degraded"],
+        "breaker_state": snap["breaker_state"],
+    }
+    print(json.dumps(result))
+    # success: the degraded rebuild happened (dp>1) and isolation caught
+    # every injected poison without collateral except the shard-loss window
+    if dp > 1 and time_to_degraded_s is None:
+        return 1
+    return 0
+
+
 def multichip_serve_bench(args) -> int:
     """dp-sharded REAL serving path, measured not asserted (ISSUE 3): the
     engine (ingest -> H2D -> sharded forward -> fetch) over every local chip
@@ -577,6 +766,24 @@ def main() -> int:
     parser.add_argument("--failover-concurrency", type=int, default=8)
     parser.add_argument("--failover-service-ms", type=float, default=5.0)
     parser.add_argument(
+        "--chaos-serve",
+        action="store_true",
+        help="run the engine-fault-domain bench instead (CPU ok over virtual "
+        "devices, tiny model): goodput + p99 through a 1%% poison stream and "
+        "a mid-run dead shard, with time-to-degraded for the dp rebuild",
+    )
+    parser.add_argument("--chaos-requests", type=int, default=300)
+    parser.add_argument("--chaos-concurrency", type=int, default=8)
+    parser.add_argument(
+        "--chaos-poison-every", type=int, default=100,
+        help="tag every Nth image as poison (100 = a 1%% poison stream)",
+    )
+    parser.add_argument(
+        "--chaos-devices", type=int, default=2,
+        help="dp width for --chaos-serve; forces that many virtual host "
+        "devices when XLA_FLAGS doesn't already pin a count",
+    )
+    parser.add_argument(
         "--multichip-serve",
         action="store_true",
         help="run the dp-sharded serving bench instead: aggregate img/s over "
@@ -600,6 +807,10 @@ def main() -> int:
         return overload_bench(args)
     if args.failover:
         return failover_bench(args)
+    if args.chaos_serve:
+        # before the jax import below: chaos_serve_bench sets the virtual
+        # device count env first
+        return chaos_serve_bench(args)
 
     import os
 

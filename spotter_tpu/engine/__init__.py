@@ -1,3 +1,20 @@
-from spotter_tpu.engine.engine import InferenceEngine  # noqa: F401
-from spotter_tpu.engine.batcher import MicroBatcher  # noqa: F401
-from spotter_tpu.engine.metrics import Metrics  # noqa: F401
+"""Engine package. The engine/batcher classes are re-exported lazily
+(PEP 562): `engine.errors` is deliberately jax-free so light processes (the
+supervisor reading `FATAL_ENGINE_EXIT_CODE`) can import it without an eager
+`engine.engine` import dragging jax (and a device backend init) along."""
+
+
+def __getattr__(name: str):
+    if name == "InferenceEngine":
+        from spotter_tpu.engine.engine import InferenceEngine
+
+        return InferenceEngine
+    if name == "MicroBatcher":
+        from spotter_tpu.engine.batcher import MicroBatcher
+
+        return MicroBatcher
+    if name == "Metrics":
+        from spotter_tpu.engine.metrics import Metrics
+
+        return Metrics
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

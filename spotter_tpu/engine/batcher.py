@@ -20,15 +20,36 @@ and releases its in-flight slot instead of deadlocking the pump; a
 `CircuitBreaker` trips after consecutive batch failures and sheds at
 admission while open; `drain()` stops admitting, flushes the queue, and
 waits for in-flight batches (the k8s preStop hook).
+
+Engine fault domain (ISSUE 4): a failed batch is no longer all-or-nothing.
+Plain errors trigger a bisect-retry (split in half, retry the halves,
+recurse, bounded by `SPOTTER_TPU_POISON_MAX_SPLITS`) so only a genuinely
+poisonous item's future fails — with `PoisonImageError` — while co-batched
+innocents succeed; an isolated poison does NOT count as an engine failure
+for the breaker (a batch where every item fails still does). A
+`FatalEngineError` from the engine (device lost) triggers the degraded-dp
+path: rebuild the engine at the largest viable width over the surviving
+shards (lifecycle re-enters `warming` during the rebuild) or, when nothing
+is left to degrade to, a controlled exit with `FATAL_ENGINE_EXIT_CODE` so
+the supervisor warm-restarts through the persistent compile cache.
 """
 
 import asyncio
+import logging
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from PIL import Image
 
 from spotter_tpu.engine.engine import InferenceEngine
+from spotter_tpu.engine.errors import (
+    DEFAULT_POISON_MAX_SPLITS,
+    FATAL_ENGINE_EXIT_CODE,
+    POISON_MAX_SPLITS_ENV,
+    FatalEngineError,
+    PoisonImageError,
+    TransientEngineError,
+)
 from spotter_tpu.serving.resilience import (
     BATCH_TIMEOUT_ENV,
     DEFAULT_BATCH_TIMEOUT_MS,
@@ -45,6 +66,8 @@ from spotter_tpu.serving.resilience import (
     _env_int,
 )
 from spotter_tpu.testing import faults
+
+logger = logging.getLogger(__name__)
 
 
 class BatchTimeoutError(RuntimeError):
@@ -64,11 +87,20 @@ class MicroBatcher:
         max_queue: Optional[int] = None,
         batch_timeout_ms: Optional[float] = None,
         breaker: Optional[CircuitBreaker] = None,
+        poison_max_splits: Optional[int] = None,
+        fatal_exit_cb: Optional[Callable[[int], None]] = None,
     ) -> None:
         """`max_queue`/`batch_timeout_ms` default from the env knobs
         (`SPOTTER_TPU_QUEUE_DEPTH`, `SPOTTER_TPU_BATCH_TIMEOUT_MS`);
         `max_queue <= 0` means unbounded, `batch_timeout_ms <= 0` disables
-        the watchdog."""
+        the watchdog. `poison_max_splits` (default
+        `SPOTTER_TPU_POISON_MAX_SPLITS`) bounds the bisect-retry recursion
+        depth; `<= 0` disables isolation (a failed batch fails whole, the
+        pre-ISSUE-4 behavior). `fatal_exit_cb` is invoked with
+        `FATAL_ENGINE_EXIT_CODE` when a fatal device error cannot be
+        survived by a degraded rebuild — the serving runtime wires
+        `os._exit` here so the supervisor can warm-restart; `None` (library
+        use, tests) just leaves the breaker to shed."""
         self.engine = engine
         self.max_batch = max_batch or engine.batch_buckets[-1]
         # Aggregate bucket sizing (ISSUE 3): under dp-sharded serving the
@@ -88,10 +120,19 @@ class MicroBatcher:
             batch_timeout_ms = _env_float(BATCH_TIMEOUT_ENV, DEFAULT_BATCH_TIMEOUT_MS)
         self.batch_timeout_s = batch_timeout_ms / 1000.0 if batch_timeout_ms > 0 else None
         self.breaker = breaker or CircuitBreaker.from_env(metrics=engine.metrics)
+        if poison_max_splits is None:
+            poison_max_splits = _env_int(
+                POISON_MAX_SPLITS_ENV, DEFAULT_POISON_MAX_SPLITS
+            )
+        self.poison_max_splits = poison_max_splits
+        self.fatal_exit_cb = fatal_exit_cb
+        self._lifecycle_tracker = None
+        self._fatal_fired = False
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max(0, max_queue))
         self._pump_task: Optional[asyncio.Task] = None
         self._in_flight: set[asyncio.Task] = set()
         self._slots: Optional[asyncio.Semaphore] = None
+        self._rebuild_lock: Optional[asyncio.Lock] = None
         self._closed = False
         self._draining = False
         # True while the pump holds a dequeued-but-undispatched batch in
@@ -103,6 +144,11 @@ class MicroBatcher:
     def draining(self) -> bool:
         return self._draining or self._closed
 
+    def attach_lifecycle(self, tracker) -> None:
+        """Give the batcher the replica's StartupTracker so a degraded
+        rebuild can re-enter `warming` (and return to `ready`) on /startupz."""
+        self._lifecycle_tracker = tracker
+
     async def start(self) -> None:
         """Idempotent; an explicit start() after stop()/drain() re-opens the
         batcher (submit() never restarts a stopped batcher on its own)."""
@@ -111,6 +157,7 @@ class MicroBatcher:
             self._draining = False
             self.engine.metrics.set_draining(False)
             self._slots = asyncio.Semaphore(self.max_in_flight)
+            self._rebuild_lock = asyncio.Lock()
             self._pump_task = asyncio.create_task(self._pump())
 
     async def stop(self) -> None:
@@ -230,11 +277,39 @@ class MicroBatcher:
             self._in_flight.add(task)
             task.add_done_callback(self._in_flight.discard)
 
-    def _call_engine(self, images: list[Image.Image]) -> list[list[dict]]:
-        """Runs in the worker thread; the fault hook may hang or raise here,
-        exactly where a wedged device call would."""
-        faults.on_engine_batch(len(images))
-        return self.engine.detect(images)
+    def _detect_outcomes(self, images: list[Image.Image], splits_left: int) -> list:
+        """Worker-thread engine call with poison bisect-retry (ISSUE 4).
+
+        Returns one outcome per image: a detections list, or the exception
+        to set on that image's future. A failed multi-image batch is split
+        in half and each half retried (recursing up to `splits_left` deep),
+        so a deterministic per-input failure converges to exactly one
+        `PoisonImageError` while every innocent neighbor gets its result.
+        Typed engine errors (transient after the engine's own retry, fatal)
+        are never bisected — they are batch-independent and propagate.
+
+        The fault hook runs at every level, exactly where a wedged or
+        poisoned device call would fail on a retry too.
+        """
+        try:
+            faults.on_engine_batch(images)
+            return list(self.engine.detect(images))
+        except (FatalEngineError, TransientEngineError):
+            raise
+        except Exception as exc:
+            if len(images) == 1:
+                err = PoisonImageError(f"image poisoned its batch: {exc!r}")
+                err.__cause__ = exc
+                return [err]
+            if splits_left <= 0:
+                # isolation exhausted/disabled: every image in this
+                # sub-batch fails with the raw error
+                return [exc] * len(images)
+            self.engine.metrics.record_batch_retry()
+            mid = len(images) // 2
+            return self._detect_outcomes(
+                images[:mid], splits_left - 1
+            ) + self._detect_outcomes(images[mid:], splits_left - 1)
 
     async def _run_batch(self, batch) -> None:
         try:
@@ -244,11 +319,13 @@ class MicroBatcher:
                 return
             images = [b[0] for b in batch]
             try:
-                detect = asyncio.to_thread(self._call_engine, images)
+                detect = asyncio.to_thread(
+                    self._detect_outcomes, images, self.poison_max_splits
+                )
                 if self.batch_timeout_s is not None:
-                    results = await asyncio.wait_for(detect, self.batch_timeout_s)
+                    outcomes = await asyncio.wait_for(detect, self.batch_timeout_s)
                 else:
-                    results = await detect
+                    outcomes = await detect
             except asyncio.TimeoutError:
                 # watchdog: the engine call is wedged — fail this batch and
                 # release the slot; the breaker decides whether to keep
@@ -263,16 +340,114 @@ class MicroBatcher:
                     if not f.done():
                         f.set_exception(exc)
                 return
-            except Exception as exc:  # contain failure to this batch only
+            except FatalEngineError as exc:
+                await self._handle_fatal(batch, exc)
+                return
+            except Exception as exc:  # transient-after-retry or unexpected:
+                # contain failure to this batch only
                 self.engine.metrics.record_error(len(batch))
                 self.breaker.record_failure()
                 for _, f, _ in batch:
                     if not f.done():
                         f.set_exception(exc)
                 return
-            self.breaker.record_success()
-            for (_, f, _), dets in zip(batch, results):
-                if not f.done():
-                    f.set_result(dets)
+            self._settle_outcomes(batch, outcomes)
         finally:
             self._slots.release()
+
+    def _settle_outcomes(self, batch, outcomes: list) -> None:
+        """Per-image results/errors plus the breaker accounting contract:
+        an isolated poison (some co-batched items succeeded) is NOT an
+        engine failure; a batch where nothing succeeded still is."""
+        failed = [o for o in outcomes if isinstance(o, BaseException)]
+        all_failed = failed and len(failed) == len(outcomes)
+        if all_failed:
+            self.breaker.record_failure()
+            self.engine.metrics.record_error(len(failed))
+        else:
+            self.breaker.record_success()
+            if failed:
+                poisons = sum(1 for o in failed if isinstance(o, PoisonImageError))
+                self.engine.metrics.record_poison_isolated(poisons)
+                self.engine.metrics.record_error(len(failed))
+        for (_, f, _), out in zip(batch, outcomes):
+            if f.done():
+                continue
+            if isinstance(out, BaseException):
+                # when the whole batch failed the "poison" label is wrong —
+                # nothing was isolated — so surface the underlying error
+                if (
+                    all_failed
+                    and isinstance(out, PoisonImageError)
+                    and out.__cause__ is not None
+                ):
+                    f.set_exception(out.__cause__)
+                else:
+                    f.set_exception(out)
+            else:
+                f.set_result(out)
+
+    async def _handle_fatal(self, batch, exc: FatalEngineError) -> None:
+        """A device died mid-batch: fail this batch's futures (the replica
+        pool replays them on a peer), then either rebuild the engine at a
+        lower dp in place or hand the process to the supervisor."""
+        self.engine.metrics.record_fatal_engine_error()
+        self.engine.metrics.record_error(len(batch))
+        self.breaker.record_failure()
+        for _, f, _ in batch:
+            if not f.done():
+                f.set_exception(exc)
+        gen = getattr(self.engine, "generation", None)
+        if getattr(self.engine, "can_degrade", lambda: False)():
+            if await self._rebuild_degraded(gen):
+                return
+        self._fatal_exit(exc)
+
+    async def _rebuild_degraded(self, gen_at_failure) -> bool:
+        """Single-flight degraded rebuild: probe the shards, rebuild the
+        engine at the largest viable dp, rescale the batcher's fill target.
+        Concurrent fatal batches queue on the lock and observe the bumped
+        generation instead of rebuilding (or exiting) again."""
+        from spotter_tpu.serving import lifecycle
+
+        async with self._rebuild_lock:
+            eng = self.engine
+            if gen_at_failure is not None and eng.generation != gen_at_failure:
+                return True  # a racing batch already rebuilt past this failure
+            tracker = self._lifecycle_tracker
+            if tracker is not None:
+                tracker.mark(lifecycle.WARMING)
+            old_dp = eng.dp
+            try:
+                alive = await asyncio.to_thread(eng.probe_shards)
+                new_dp = await asyncio.to_thread(eng.rebuild_degraded, alive)
+            except Exception:
+                logger.exception(
+                    "degraded rebuild failed (dp=%d); falling through to "
+                    "fatal exit", old_dp,
+                )
+                return False
+            self.max_batch = eng.batch_buckets[-1]
+            eng.metrics.set_aggregate_bucket(self.max_batch)
+            if tracker is not None:
+                tracker.mark(lifecycle.READY)
+            logger.warning(
+                "engine rebuilt degraded dp=%d -> dp=%d (aggregate bucket %d)",
+                old_dp, new_dp, self.max_batch,
+            )
+            return True
+
+    def _fatal_exit(self, exc: FatalEngineError) -> None:
+        """Controlled exit on an unsurvivable device loss: distinct code so
+        the supervisor warm-restarts immediately (compile cache makes it
+        cheap) instead of applying crash backoff. Without a callback
+        (library/test use) the breaker is left to shed."""
+        if self._fatal_fired:
+            return
+        self._fatal_fired = True
+        if self.fatal_exit_cb is not None:
+            logger.error(
+                "fatal engine error with nothing left to degrade to; exiting "
+                "%d for supervisor warm restart: %s", FATAL_ENGINE_EXIT_CODE, exc,
+            )
+            self.fatal_exit_cb(FATAL_ENGINE_EXIT_CODE)

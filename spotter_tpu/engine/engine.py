@@ -13,6 +13,7 @@ async request handlers.
 """
 
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -23,7 +24,14 @@ import jax.numpy as jnp
 import numpy as np
 from PIL import Image
 
+from spotter_tpu.engine.errors import (
+    FatalEngineError,
+    TransientEngineError,
+    as_typed,
+    classify_engine_exception,
+)
 from spotter_tpu.engine.metrics import Metrics
+from spotter_tpu.testing import faults
 from spotter_tpu.ops.postprocess import (
     sigmoid_max_postprocess,
     sigmoid_topk_postprocess,
@@ -40,6 +48,11 @@ from spotter_tpu.ops.preprocess import (
 )
 
 DEVICE_PREPROCESS_ENV = "SPOTTER_TPU_DEVICE_PREPROCESS"
+
+# How long a detect() call will wait for an in-progress degraded rebuild
+# (compile of the rescaled ladder included) before proceeding anyway; the
+# batcher watchdog bounds the overall call regardless.
+REBUILD_GATE_WAIT_S = 300.0
 
 POSTPROCESS_KINDS = {
     "sigmoid_topk": sigmoid_topk_postprocess,      # RT-DETR family
@@ -105,7 +118,7 @@ class InferenceEngine:
         self.built = built
         self.threshold = threshold
         self.metrics = metrics or Metrics()
-        self.mesh = mesh
+        self.tp_rules = tuple(tp_rules)
         if device_preprocess is None:
             device_preprocess = (
                 os.environ.get(DEVICE_PREPROCESS_ENV, "0").strip() not in ("", "0")
@@ -114,22 +127,14 @@ class InferenceEngine:
             built.preprocess_spec
         )
         self._decode_pool = decode_pool or DecodePool()
-        if mesh is not None:
-            from spotter_tpu.parallel.sharding import data_sharding, shard_params
-
-            dp = mesh.shape["dp"]
-            # every bucket must split evenly across dp shards: round UP so the
-            # configured max batch capacity is kept, never shrunk
-            batch_buckets = sorted({-(-b // dp) * dp for b in batch_buckets})
-            self.batch_buckets = tuple(batch_buckets)
-            self.device = None
-            self.params = shard_params(built.params, mesh, tp_rules)
-            self._in_sharding = data_sharding(mesh)
-        else:
-            self.batch_buckets = tuple(sorted(batch_buckets))
-            self.device = device or jax.devices()[0]
-            self.params = jax.device_put(built.params, self.device)
-            self._in_sharding = self.device
+        self._place(mesh, device, batch_buckets)
+        # Fault-domain state (ISSUE 4): the dp width this engine was built
+        # for, a generation counter bumped by every in-place rebuild, and a
+        # gate detect() waits on while a degraded rebuild swaps placement.
+        self.initial_dp = self.dp
+        self.generation = 0
+        self._rebuild_gate = threading.Event()
+        self._rebuild_gate.set()
         post_fn = POSTPROCESS_KINDS[built.postprocess]
         k = built.num_top_queries
 
@@ -162,10 +167,105 @@ class InferenceEngine:
             forward, donate_argnums=(1,) if donate_pixels else ()
         )
 
+    def _place(self, mesh, device, batch_buckets: Sequence[int]) -> None:
+        """Bind params + input sharding + bucket ladder to a topology.
+
+        Called at construction and again by `rebuild_degraded` — params are
+        always re-placed from the host copy in `self.built.params`, so a
+        rebuild never depends on state held by a dead device.
+        """
+        self.mesh = mesh
+        if mesh is not None:
+            from spotter_tpu.parallel.sharding import data_sharding, shard_params
+
+            dp = mesh.shape["dp"]
+            # every bucket must split evenly across dp shards: round UP so the
+            # configured max batch capacity is kept, never shrunk
+            batch_buckets = sorted({-(-b // dp) * dp for b in batch_buckets})
+            self.batch_buckets = tuple(batch_buckets)
+            self.device = None
+            self.params = shard_params(self.built.params, mesh, self.tp_rules)
+            self._in_sharding = data_sharding(mesh)
+        else:
+            self.batch_buckets = tuple(sorted(batch_buckets))
+            self.device = device or jax.devices()[0]
+            self.params = jax.device_put(self.built.params, self.device)
+            self._in_sharding = self.device
+
     @property
     def dp(self) -> int:
         """Data-parallel width the serving batch is sharded over (1 = single chip)."""
         return int(self.mesh.shape["dp"]) if self.mesh is not None else 1
+
+    def devices(self) -> list:
+        """The devices this engine currently places work on."""
+        if self.mesh is None:
+            return [self.device]
+        return list(self.mesh.devices.flat)
+
+    def can_degrade(self) -> bool:
+        """True when a fatal shard loss can be survived in place: dp-sharded
+        (something to shrink) and tp=1 (params whole on every chip)."""
+        return (
+            self.mesh is not None
+            and self.dp > 1
+            and int(self.mesh.shape.get("tp", 1)) == 1
+        )
+
+    def probe_shards(self) -> list:
+        """Shard health probe: a tiny per-device compute ping; returns the
+        devices that answered. A dead/halted chip raises (or hangs inside
+        the runtime's own deadline) instead of echoing the value back."""
+        alive = []
+        for d in self.devices():
+            try:
+                faults.on_shard_probe(d.id)
+                x = jax.device_put(np.ones((8,), np.float32), d)
+                jax.block_until_ready(x + 1.0)
+                alive.append(d)
+            except Exception:
+                continue
+        return alive
+
+    def rebuild_degraded(self, alive_devices: Sequence) -> int:
+        """Rebuild in place at the largest viable dp over `alive_devices`.
+
+        4 -> 2 -> 1: halve the width until it fits the surviving shards,
+        rescale the aggregate bucket ladder to keep the per-chip batch the
+        ladder was tuned for, re-place params from the host copy, and
+        re-warm every bucket so the first post-rebuild batch doesn't pay a
+        compile. Bumps `generation`; detect() calls arriving mid-rebuild
+        wait on the gate instead of racing the placement swap.
+        """
+        old_dp = self.dp
+        if not alive_devices:
+            raise FatalEngineError(
+                f"no alive devices to rebuild on (was dp={old_dp})"
+            )
+        new_dp = old_dp
+        while new_dp > len(alive_devices):
+            new_dp //= 2
+        if new_dp < 1:
+            raise FatalEngineError(
+                f"cannot fit any dp width on {len(alive_devices)} alive devices"
+            )
+        per_chip = sorted({max(1, b // old_dp) for b in self.batch_buckets})
+        new_buckets = tuple(b * new_dp for b in per_chip)
+        self._rebuild_gate.clear()
+        try:
+            from spotter_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(dp=new_dp, tp=1, devices=list(alive_devices)[:new_dp])
+            self._place(mesh, None, new_buckets)
+            self.warmup()
+            # bumped only once the rescaled ladder is compiled and warm:
+            # "generation advanced" means "serving again", so the
+            # time-to-degraded measurement can't flatter itself
+            self.generation += 1
+            self.metrics.record_engine_rebuild(old_dp, self.dp)
+        finally:
+            self._rebuild_gate.set()
+        return self.dp
 
     def bucket_for(self, n: int) -> int:
         for b in self.batch_buckets:
@@ -205,7 +305,12 @@ class InferenceEngine:
             return jax.make_array_from_single_device_arrays(
                 arr.shape, self._in_sharding, shards
             )
-        except Exception:  # multi-host or API drift: the one-call path is correct
+        except (AttributeError, TypeError, KeyError, ValueError, NotImplementedError):
+            # multi-host or API drift: the one-call path is correct. ONLY
+            # shape/API mismatches fall through — a real per-shard H2D
+            # failure is a RuntimeError (XlaRuntimeError) and must surface
+            # to the failure classifier, not be silently retried as a
+            # monolithic device_put that would hit the same dead chip.
             return jax.device_put(arr, self._in_sharding)
 
     def detect(self, images: list[Image.Image]) -> list[list[dict]]:
@@ -218,20 +323,71 @@ class InferenceEngine:
         normalize, device_put) and the D2H fetch of chunk N-1 both overlap
         chunk N's device compute instead of serializing with it. Single-chunk
         calls behave exactly as before (stage -> dispatch -> fetch).
+
+        Failure classification (ISSUE 4): device exceptions anywhere in the
+        stage/dispatch/fetch chain are classified (engine/errors.py). A
+        transient error (RESOURCE_EXHAUSTED) downgrades the chunk to the
+        next-smaller bucket — split in half, retried once, serially — with
+        no caller-visible failure; a fatal error (device lost / DATA_LOSS)
+        raises `FatalEngineError` for the batcher's degraded-rebuild /
+        controlled-exit path; plain model errors propagate unchanged so the
+        batcher's poison bisect can isolate them per image.
         """
+        if not self._rebuild_gate.is_set():
+            # a degraded rebuild is swapping placement under us: wait it out
+            # rather than racing half-moved params (bounded by the watchdog
+            # one layer up either way)
+            self._rebuild_gate.wait(timeout=REBUILD_GATE_WAIT_S)
         results: list[list[dict]] = []
         max_b = self.batch_buckets[-1]
         chunks = [images[i : i + max_b] for i in range(0, len(images), max_b)]
-        pending = None
+        pending = None  # (dispatched_item, chunk_images)
         for chunk in chunks:
-            staged = self._stage(chunk)
-            dispatched = self._dispatch(staged)
+            try:
+                dispatched = self._dispatch(self._stage(chunk))
+            except Exception as exc:
+                # keep result order: finish the older in-flight chunk first,
+                # then recover (or fail) this one
+                if pending is not None:
+                    results.extend(self._finish_or_recover(*pending))
+                    pending = None
+                results.extend(self._recover_chunk(chunk, exc))
+                continue
             if pending is not None:
-                results.extend(self._finish(pending))
-            pending = dispatched
+                results.extend(self._finish_or_recover(*pending))
+            pending = (dispatched, chunk)
         if pending is not None:
-            results.extend(self._finish(pending))
+            results.extend(self._finish_or_recover(*pending))
         return results
+
+    def _finish_or_recover(self, dispatched_item, images: list[Image.Image]):
+        try:
+            return self._finish(dispatched_item)
+        except Exception as exc:
+            return self._recover_chunk(images, exc)
+
+    def _recover_chunk(
+        self, images: list[Image.Image], exc: Exception
+    ) -> list[list[dict]]:
+        """Classify a failed chunk and recover when the taxonomy allows it."""
+        kind = classify_engine_exception(exc)
+        if kind is FatalEngineError:
+            raise as_typed(exc)
+        if kind is TransientEngineError:
+            # bucket-downgrade retry, once: the halves land in the
+            # next-smaller bucket, which is exactly the recovery for an
+            # HBM-OOM at the top bucket. A second failure propagates typed.
+            self.metrics.record_batch_retry()
+            try:
+                if len(images) <= 1:
+                    return self._detect_chunk(images)
+                mid = (len(images) + 1) // 2
+                return self._detect_chunk(images[:mid]) + self._detect_chunk(
+                    images[mid:]
+                )
+            except Exception as retry_exc:
+                raise as_typed(retry_exc) from retry_exc
+        raise exc
 
     def _detect_chunk(self, images: list[Image.Image]) -> list[list[dict]]:
         """Serial stage -> dispatch -> fetch for one chunk (<= max bucket)."""
@@ -288,6 +444,9 @@ class InferenceEngine:
     def _dispatch(self, staged_item):
         """Async-dispatch the compiled forward; no host blocking."""
         staged, n, t0, t_decode, t_pre = staged_item
+        # fault seam: a dead-shard or device-OOM injection raises here with
+        # the same status markers the real runtime would embed
+        faults.on_engine_dispatch(n, [d.id for d in self.devices()])
         outputs = self._forward(self.params, *staged)
         # queue the D2H copies now: they start the moment compute finishes,
         # overlapping the next chunk's staging instead of its fetch

@@ -1,0 +1,111 @@
+"""Engine failure taxonomy: poison vs transient vs fatal (ISSUE 4).
+
+PR 2 made the *replica* a restartable unit; this module makes the *engine*
+a classified fault domain. Production TPU serving systems (DeepServe's
+serverless pools, Spotlight's spot-instance training — PAPERS.md) survive
+device faults by treating them as typed, recoverable events; a serving
+engine that maps every exception to "the batch failed" turns one flaky chip
+or one poisonous JPEG into a dead 4-chip replica. Three classes, three
+recovery policies:
+
+- `PoisonImageError` — one specific input breaks any batch containing it
+  (decode bomb, NaN-producing content, injected poison). Recovery: the
+  MicroBatcher bisect-retries the batch so only the poisonous item's future
+  fails; co-batched innocents succeed, and the CircuitBreaker does NOT
+  count an isolated poison as an engine failure.
+- `TransientEngineError` — the device call failed in a way a smaller or
+  repeated attempt can survive (RESOURCE_EXHAUSTED / HBM OOM). Recovery:
+  the engine downgrades to the next-smaller bucket (splits the batch in
+  half) and retries once, invisibly to clients.
+- `FatalEngineError` — the device itself is gone (DATA_LOSS, device lost /
+  halted). Recovery: under dp>1 the engine rebuilds itself at the largest
+  viable dp over the shards that still answer a health probe (degraded
+  mode); at dp=1 the process exits with `FATAL_ENGINE_EXIT_CODE` so the
+  supervisor does an immediate warm restart through the persistent compile
+  cache.
+
+Anything unclassified is a plain model/host error and propagates unchanged
+(after the poison bisect has had its chance to isolate it per-image).
+
+This module must stay import-light (no jax): `serving/supervisor.py` reads
+`FATAL_ENGINE_EXIT_CODE` from here in processes that never touch a device.
+"""
+
+POISON_MAX_SPLITS_ENV = "SPOTTER_TPU_POISON_MAX_SPLITS"
+DEFAULT_POISON_MAX_SPLITS = 4  # isolates 1 poison in a bucket of up to 16
+
+# Distinct from BRINGUP_FAILED (82), PREEMPTED (83), CRASH_LOOP (84): the
+# engine hit a fatal device error at dp=1 (nothing left to degrade to) and
+# exited deliberately. The supervisor restarts it immediately — the compile
+# cache makes the restart warm — instead of applying crash backoff.
+FATAL_ENGINE_EXIT_CODE = 85
+
+
+class EngineError(RuntimeError):
+    """Base class for the classified engine failure taxonomy."""
+
+
+class PoisonImageError(EngineError):
+    """A specific input image poisoned its batch; only ITS future fails."""
+
+
+class TransientEngineError(EngineError):
+    """Retryable device-side failure (OOM and friends): downgrade + retry."""
+
+
+class FatalEngineError(EngineError):
+    """The device is lost/halted: rebuild degraded or exit for warm restart."""
+
+
+# Classification is by status-code markers in the exception message, not by
+# exception type: jax raises XlaRuntimeError/JaxRuntimeError with the XLA
+# status embedded in the text, the exact class moves between jax versions,
+# and the fault harness injects plain RuntimeErrors carrying the same
+# markers. Markers are matched case-insensitively.
+_TRANSIENT_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "hbm oom",
+    "allocator ran out",
+)
+_FATAL_MARKERS = (
+    "data_loss",
+    "data loss",
+    "device lost",
+    "device is lost",
+    "device halted",
+    "device is halted",
+    "chip lost",
+    "hardware failure",
+    "tpu driver",
+)
+
+
+def classify_engine_exception(exc: BaseException) -> type | None:
+    """Map an exception to its taxonomy class, or None for plain errors.
+
+    Already-typed `EngineError`s classify as their own type, so wrapping
+    layers can re-classify without double-wrapping.
+    """
+    if isinstance(exc, EngineError):
+        for cls in (PoisonImageError, TransientEngineError, FatalEngineError):
+            if isinstance(exc, cls):
+                return cls
+        return None
+    msg = str(exc).lower()
+    if any(marker in msg for marker in _FATAL_MARKERS):
+        return FatalEngineError
+    if any(marker in msg for marker in _TRANSIENT_MARKERS):
+        return TransientEngineError
+    return None
+
+
+def as_typed(exc: BaseException) -> BaseException:
+    """Return `exc` wrapped in its taxonomy class (or unchanged if plain)."""
+    kind = classify_engine_exception(exc)
+    if kind is None or isinstance(exc, EngineError):
+        return exc
+    wrapped = kind(str(exc))
+    wrapped.__cause__ = exc
+    return wrapped

@@ -48,6 +48,16 @@ class Metrics:
         self._h2d_images_total = 0
         self._decode_queue_depth = 0
         self._aggregate_bucket = 0
+        # Engine fault domain (ISSUE 4): poison items isolated by the
+        # bisect-retry, batch retries it (and the OOM bucket-downgrade)
+        # spent, fatal device errors seen, in-place engine rebuilds, and the
+        # current degraded-dp shape ({"from": n, "to": m} once a shard has
+        # been lost; None while serving at full width).
+        self._poison_isolated_total = 0
+        self._batch_retries_total = 0
+        self._fatal_engine_errors_total = 0
+        self._engine_rebuilds_total = 0
+        self._dp_degraded: dict | None = None
 
     def record_batch(
         self,
@@ -106,6 +116,26 @@ class Metrics:
             self._h2d_bytes_total += nbytes
             self._h2d_images_total += n_images
 
+    def record_poison_isolated(self, n: int = 1) -> None:
+        """n poisonous items isolated to their own futures by bisect-retry."""
+        with self._lock:
+            self._poison_isolated_total += n
+
+    def record_batch_retry(self, n: int = 1) -> None:
+        """A failed batch was split and retried (poison bisect or OOM downgrade)."""
+        with self._lock:
+            self._batch_retries_total += n
+
+    def record_fatal_engine_error(self) -> None:
+        with self._lock:
+            self._fatal_engine_errors_total += 1
+
+    def record_engine_rebuild(self, from_dp: int, to_dp: int) -> None:
+        """The engine rebuilt itself in place at a different dp width."""
+        with self._lock:
+            self._engine_rebuilds_total += 1
+            self._dp_degraded = {"from": from_dp, "to": to_dp}
+
     def set_decode_queue_depth(self, depth: int) -> None:
         with self._lock:
             self._decode_queue_depth = depth
@@ -162,6 +192,11 @@ class Metrics:
                 "aggregate_bucket": self._aggregate_bucket,
                 "images_total": self._images_total,
                 "errors_total": self._errors_total,
+                "poison_isolated_total": self._poison_isolated_total,
+                "batch_retries_total": self._batch_retries_total,
+                "fatal_engine_errors_total": self._fatal_engine_errors_total,
+                "engine_rebuilds_total": self._engine_rebuilds_total,
+                "dp_degraded": self._dp_degraded,
                 "shed_total": self._shed_total,
                 "deadline_exceeded_total": self._deadline_exceeded_total,
                 "batch_timeouts_total": self._batch_timeouts_total,

@@ -20,6 +20,9 @@ from functools import partial
 import numpy as np
 from PIL import Image
 
+MAX_IMAGE_PIXELS_ENV = "SPOTTER_TPU_MAX_IMAGE_PIXELS"
+DEFAULT_MAX_IMAGE_PIXELS = 64_000_000  # ~64 MP; <= 0 disables the guard
+
 IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
 CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
@@ -78,6 +81,37 @@ OWLV2_SPEC = PreprocessSpec(
 )
 
 
+class ImageTooLargeError(ValueError):
+    """Decode-bomb guard tripped: the image's pixel count exceeds
+    SPOTTER_TPU_MAX_IMAGE_PIXELS. A per-image error, never a host OOM."""
+
+
+def check_image_pixels(image: Image.Image) -> None:
+    """Reject decode bombs BEFORE any full decode/resize touches them.
+
+    PIL reads dimensions from the header without decoding pixel data, so
+    this check is cheap; a 4 GB-decoded "tiny" JPEG otherwise OOMs the host
+    inside convert()/resize(). Called from the detector (right after
+    Image.open) and from both DecodePool preprocess paths; inside the
+    engine a tripped guard is a per-image poison the bisect-retry isolates.
+    """
+    raw = os.environ.get(MAX_IMAGE_PIXELS_ENV, "").strip()
+    try:
+        cap = int(raw) if raw else DEFAULT_MAX_IMAGE_PIXELS
+    except ValueError:
+        raise ValueError(
+            f"{MAX_IMAGE_PIXELS_ENV} must be an integer, got {raw!r}"
+        ) from None
+    if cap <= 0:
+        return
+    n = image.width * image.height
+    if n > cap:
+        raise ImageTooLargeError(
+            f"image {image.width}x{image.height} = {n} px exceeds "
+            f"{MAX_IMAGE_PIXELS_ENV}={cap} (decode-bomb guard)"
+        )
+
+
 def shortest_edge_size(hw: tuple[int, int], short: int, longest: int) -> tuple[int, int]:
     """Output (h, w) for aspect-preserving shortest-edge resize with a long-side cap.
 
@@ -120,6 +154,7 @@ def preprocess_image(
     pixel_mask is all-ones for fixed mode; for shortest_edge mode it marks valid
     (non-pad) pixels, the analog of HF DETR's pixel_mask.
     """
+    check_image_pixels(image)
     orig_hw = (image.height, image.width)
 
     def rescale_normalize(a: np.ndarray) -> np.ndarray:
@@ -279,6 +314,7 @@ def decode_resize_uint8(
     filter and shortest-edge arithmetic as `preprocess_image` (golden parity
     depends on them) — rescale/normalize/mask move to the device.
     """
+    check_image_pixels(image)
     orig_hw = (image.height, image.width)
     if spec.mode == "fixed":
         th, tw = spec.size

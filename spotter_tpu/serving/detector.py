@@ -27,6 +27,13 @@ draining) stay per-image errors when the request is partially served, but a
 fully-shed request re-raises so the HTTP layer can answer 429/503 with
 Retry-After. tenacity is optional: when absent (minimal images) a local
 retry loop preserves the same 3-attempt/4-10 s-backoff contract.
+
+Fetch hardening (ISSUE 4 satellite): fetches are bounded in time
+(`SPOTTER_TPU_FETCH_TIMEOUT_S`) and bytes (`SPOTTER_TPU_FETCH_MAX_BYTES`,
+content-length reject + streamed read cap), failures are a typed
+`FetchError`, deterministic 4xx statuses are not retried, and
+`SPOTTER_TPU_MAX_IMAGE_PIXELS` rejects decode bombs before convert()
+decodes them.
 """
 
 import asyncio
@@ -38,7 +45,12 @@ import httpx
 from PIL import Image, ImageDraw
 
 try:
-    from tenacity import AsyncRetrying, stop_after_attempt, wait_exponential
+    from tenacity import (
+        AsyncRetrying,
+        retry_if_exception,
+        stop_after_attempt,
+        wait_exponential,
+    )
 
     _HAVE_TENACITY = True
 except ImportError:  # minimal image — fallback loop below keeps the contract
@@ -61,7 +73,10 @@ from spotter_tpu.serving.resilience import (
     Deadline,
     DeadlineExceededError,
     DrainingError,
+    _env_float,
+    _env_int,
 )
+from spotter_tpu.ops.preprocess import check_image_pixels
 from spotter_tpu.taxonomy import AMENITIES_MAPPING
 from spotter_tpu.testing import faults
 
@@ -70,6 +85,39 @@ from spotter_tpu.testing import faults
 FETCH_RETRY_ATTEMPTS = 3
 FETCH_RETRY_WAIT_MIN_S = 4.0
 FETCH_RETRY_WAIT_MAX_S = 10.0
+
+# Fetch hardening (ISSUE 4 satellite): every outbound image fetch is bounded
+# in time and bytes, and client errors that can never succeed (404 and
+# friends) are not retried through 22 s of backoff.
+FETCH_TIMEOUT_ENV = "SPOTTER_TPU_FETCH_TIMEOUT_S"
+DEFAULT_FETCH_TIMEOUT_S = 15.0
+FETCH_MAX_BYTES_ENV = "SPOTTER_TPU_FETCH_MAX_BYTES"
+DEFAULT_FETCH_MAX_BYTES = 32 * 1024 * 1024
+# 4xx statuses that ARE worth retrying (timeout, rate limit); every other
+# 4xx is deterministic and fails fast
+RETRYABLE_4XX = (408, 429)
+
+
+class FetchError(RuntimeError):
+    """Typed image-fetch failure (size cap, retries exhausted). Replaces the
+    bare `Exception("Failed to fetch image after retries")`; `retryable`
+    tells the retry loop whether another attempt could possibly succeed."""
+
+    def __init__(self, message: str, retryable: bool = False) -> None:
+        super().__init__(message)
+        self.retryable = retryable
+
+
+def _fetch_retryable(exc: BaseException) -> bool:
+    """Retry connect/timeout/5xx; never deterministic failures (non-408/429
+    4xx, size-cap rejections)."""
+    if isinstance(exc, FetchError):
+        return exc.retryable
+    if isinstance(exc, httpx.HTTPStatusError):
+        code = exc.response.status_code
+        if 400 <= code < 500:
+            return code in RETRYABLE_4XX
+    return True
 
 
 class AmenitiesDetector:
@@ -83,19 +131,58 @@ class AmenitiesDetector:
     ) -> None:
         self.engine = engine
         self.batcher = batcher or MicroBatcher(engine)
-        self.client = client or httpx.AsyncClient()
+        self.fetch_timeout_s = _env_float(FETCH_TIMEOUT_ENV, DEFAULT_FETCH_TIMEOUT_S)
+        self.fetch_max_bytes = _env_int(FETCH_MAX_BYTES_ENV, DEFAULT_FETCH_MAX_BYTES)
+        self.client = client or httpx.AsyncClient(timeout=self.fetch_timeout_s)
+
+    def _check_fetch_size(self, url: str, nbytes: int) -> None:
+        if self.fetch_max_bytes > 0 and nbytes > self.fetch_max_bytes:
+            raise FetchError(
+                f"image at {url} is {nbytes} bytes, over "
+                f"{FETCH_MAX_BYTES_ENV}={self.fetch_max_bytes}",
+                retryable=False,
+            )
+
+    async def _fetch_streamed(self, url: str) -> bytes:
+        """Streamed fetch with the byte cap enforced as bytes arrive: a
+        mis-labeled (or absent) content-length cannot buffer past the cap."""
+        async with self.client.stream("GET", url) as response:
+            response.raise_for_status()
+            declared = response.headers.get("content-length")
+            if declared is not None:
+                try:
+                    self._check_fetch_size(url, int(declared))
+                except ValueError:
+                    pass  # unparsable header: the read cap still applies
+            chunks: list[bytes] = []
+            total = 0
+            async for chunk in response.aiter_bytes():
+                total += len(chunk)
+                self._check_fetch_size(url, total)
+                chunks.append(chunk)
+            return b"".join(chunks)
 
     async def _fetch_image_bytes(self, url: str) -> bytes:
         injected = await faults.on_fetch(url)
         if injected is not None:
             return injected
+        # Streaming (early content-length reject + incremental read cap)
+        # needs a REAL httpx client; duck-typed stand-ins (the stub engine's
+        # canned fetcher, mocked clients in tests) keep the plain get()
+        # contract and still get the post-hoc size check.
+        if type(self.client) is httpx.AsyncClient:
+            return await self._fetch_streamed(url)
         response = await self.client.get(url)
         response.raise_for_status()
+        self._check_fetch_size(url, len(response.content))
         return response.content
 
     async def _fetch_with_retries(self, url: str) -> bytes:
         """3 attempts, exponential backoff in [min, max] s, reraise — the
-        reference policy, with or without tenacity installed."""
+        reference policy, with or without tenacity installed. Deterministic
+        failures (non-408/429 4xx, size-cap rejections) are NOT retried: a
+        404 re-fetched 3 times through 22 s of backoff is pure added load
+        and latency with an unchanged outcome."""
         if _HAVE_TENACITY:
             image_bytes = None
             retries = AsyncRetrying(
@@ -103,26 +190,27 @@ class AmenitiesDetector:
                 wait=wait_exponential(
                     multiplier=1, min=FETCH_RETRY_WAIT_MIN_S, max=FETCH_RETRY_WAIT_MAX_S
                 ),
+                retry=retry_if_exception(_fetch_retryable),
                 reraise=True,
             )
             async for attempt in retries:
                 with attempt:
                     image_bytes = await self._fetch_image_bytes(url)
             if image_bytes is None:
-                raise Exception("Failed to fetch image after retries")
+                raise FetchError("failed to fetch image after retries")
             return image_bytes
         for attempt in range(1, FETCH_RETRY_ATTEMPTS + 1):
             try:
                 return await self._fetch_image_bytes(url)
-            except Exception:
-                if attempt == FETCH_RETRY_ATTEMPTS:
+            except Exception as exc:
+                if attempt == FETCH_RETRY_ATTEMPTS or not _fetch_retryable(exc):
                     raise
                 wait = min(
                     max(float(2**attempt), FETCH_RETRY_WAIT_MIN_S),
                     FETCH_RETRY_WAIT_MAX_S,
                 )
                 await asyncio.sleep(wait)
-        raise Exception("Failed to fetch image after retries")  # unreachable
+        raise FetchError("failed to fetch image after retries")  # unreachable
 
     async def _process_single_image(
         self, url: str, deadline: Deadline | None = None
@@ -135,6 +223,9 @@ class AmenitiesDetector:
                 image_bytes = await fetch
 
             with Image.open(BytesIO(image_bytes)) as img_raw:
+                # decode-bomb guard: the header-declared pixel count is
+                # checked BEFORE convert() decodes anything (preprocess.py)
+                check_image_pixels(img_raw)
                 image = img_raw.convert("RGB")
 
             raw_detections = await self.batcher.submit(image, deadline=deadline)
@@ -170,6 +261,8 @@ class AmenitiesDetector:
             # propagate so detect() can turn a fully-shed request into
             # HTTP 429/503; partially-shed requests degrade per image there
             raise
+        except FetchError as e:
+            return DetectionErrorResult(url=url, error=f"Fetch Error: {e}")
         except httpx.HTTPError as e:
             return DetectionErrorResult(url=url, error=f"HTTP Error: {e}")
         except Exception as e:
@@ -233,16 +326,29 @@ class AmenitiesDetector:
         breaker = self.batcher.breaker
         draining = self.batcher.draining
         ready = breaker.state == CircuitBreaker.CLOSED and not draining
+        dp = getattr(self.engine, "dp", 1)
+        initial_dp = getattr(self.engine, "initial_dp", dp)
         return {
-            "status": "ok" if ready else "unready",
+            # a degraded replica is still READY (it serves, at reduced
+            # capacity) — "degraded" is the status the fleet alert keys on
+            "status": (
+                "ok" if ready and dp >= initial_dp
+                else "degraded" if ready
+                else "unready"
+            ),
             "ready": ready,
             "breaker": breaker.state,
             "draining": draining,
             # ingest/topology config (ISSUE 3): which serving shape this
             # replica runs — dp width and whether preprocess is on-device —
             # so a fleet rollout of the new pipeline is auditable per pod
-            "dp": getattr(self.engine, "dp", 1),
+            "dp": dp,
             "device_preprocess": getattr(self.engine, "device_preprocess", False),
+            # engine fault domain (ISSUE 4): lost-shard degradation state
+            "dp_degraded": (
+                {"from": initial_dp, "to": dp} if dp < initial_dp else None
+            ),
+            "engine_generation": getattr(self.engine, "generation", 0),
         }
 
     async def drain(self) -> dict:

@@ -112,6 +112,7 @@ def make_app(
     warmup: bool = False,
     preemption: bool = False,
     bringup_exit_cb=os._exit,
+    fatal_exit_cb=os._exit,
 ) -> web.Application:
     """Build the serving app.
 
@@ -125,6 +126,12 @@ def make_app(
     react to process exit. It marks the terminal `failed` startup state and
     calls `bringup_exit_cb(BRINGUP_FAILED_EXIT_CODE)` (default `os._exit`,
     overridable in tests) so the crash-loop/backoff machinery takes over.
+
+    Engine fault domain (ISSUE 4): the batcher is wired with the startup
+    tracker (a degraded-dp rebuild re-enters `warming` on /startupz) and
+    with `fatal_exit_cb` — on a fatal device error at dp=1 the process
+    exits `FATAL_ENGINE_EXIT_CODE` (85) for an immediate supervisor warm
+    restart instead of serving breaker-open 503s off a dead chip.
     """
     app = web.Application(client_max_size=64 * 1024 * 1024)
     tracker = lifecycle.StartupTracker()
@@ -136,8 +143,15 @@ def make_app(
             "never production",
             faults.FAULTS_ENV,
         )
+
+    def _wire_fault_domain(det) -> None:
+        det.batcher.attach_lifecycle(tracker)
+        if det.batcher.fatal_exit_cb is None:
+            det.batcher.fatal_exit_cb = fatal_exit_cb
+
     if detector is not None:
         detector.engine.metrics.set_restarts(lifecycle.restarts_from_env())
+        _wire_fault_domain(detector)
         tracker.mark_ready(detector.engine.metrics)
 
     async def _bring_up(app: web.Application) -> None:
@@ -151,6 +165,7 @@ def make_app(
                 await loop.run_in_executor(None, det.engine.warmup)
             app["detector"] = det
             det.engine.metrics.set_restarts(lifecycle.restarts_from_env())
+            _wire_fault_domain(det)
             ttr = tracker.mark_ready(det.engine.metrics)
             logger.info("replica ready in %.1f s", ttr)
         except asyncio.CancelledError:  # server shutdown mid-bring-up

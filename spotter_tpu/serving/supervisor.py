@@ -13,6 +13,11 @@ NOT hot-looping when the server crashes at import time. Policy:
   first `--preempt-fast` consecutive sub-min-uptime preemption exits restart
   for free — after that the normal exponential backoff applies so the pair
   cannot hot-loop spawn→drain→exit;
+- `FATAL_ENGINE_EXIT_CODE` (engine/errors.py: fatal device error with
+  nothing left to degrade to) → immediate warm restart: the persistent
+  compile cache makes the respawn cheap and the device usually comes back
+  healthy after a re-init. Same fast-limit guard as preemption — a chip
+  that stays dead must not hot-loop spawn→fatal→exit;
 - any other exit → restart after exponential backoff (`--backoff-base`,
   doubling to `--backoff-max`); a child that stayed up ≥ `--min-uptime`
   resets the backoff;
@@ -35,6 +40,7 @@ import sys
 import threading
 import time
 
+from spotter_tpu.engine.errors import FATAL_ENGINE_EXIT_CODE
 from spotter_tpu.serving.lifecycle import PREEMPTED_EXIT_CODE, RESTARTS_ENV
 
 logger = logging.getLogger(__name__)
@@ -103,6 +109,7 @@ class Supervisor:
         backoff = 0.0
         consecutive_fast_crashes = 0
         consecutive_fast_preempts = 0
+        consecutive_fast_fatals = 0
         code = 0
         while True:
             if self._terminating:
@@ -125,7 +132,39 @@ class Supervisor:
             if code == 0:
                 logger.info("child exited cleanly; supervisor done")
                 return 0
-            if code == PREEMPTED_EXIT_CODE:
+            if code == FATAL_ENGINE_EXIT_CODE:
+                # controlled fatal-device exit (engine fault domain): restart
+                # immediately — the persistent compile cache makes it a warm
+                # bring-up and a re-initialized runtime usually gets the
+                # device back. Same hot-loop guard as preemption: a chip
+                # that STAYS dead falls back to exponential backoff after
+                # `preempt_fast_limit` consecutive fast exits.
+                consecutive_fast_crashes = 0
+                consecutive_fast_preempts = 0
+                if uptime >= self.min_uptime_s:
+                    consecutive_fast_fatals = 0
+                else:
+                    consecutive_fast_fatals += 1
+                if consecutive_fast_fatals <= self.preempt_fast_limit:
+                    logger.warning(
+                        "child hit a fatal engine error (exit %d); immediate "
+                        "warm restart via compile cache", code,
+                    )
+                    backoff = 0.0
+                else:
+                    backoff = min(
+                        max(backoff * 2.0, self.backoff_base_s), self.backoff_max_s
+                    )
+                    logger.warning(
+                        "child hit fatal engine errors (exit %d) %d times under "
+                        "%.1f s uptime — device appears to stay dead; "
+                        "restarting in %.2f s",
+                        code, consecutive_fast_fatals, self.min_uptime_s, backoff,
+                    )
+                    if self._term_event.wait(backoff):
+                        logger.info("terminated during backoff; exiting %d", code)
+                        return code
+            elif code == PREEMPTED_EXIT_CODE:
                 # drained preemption: the replica is healthy software on
                 # yanked capacity — restart immediately, no backoff debt. But
                 # the source can persist (the maintenance file is never
@@ -133,6 +172,7 @@ class Supervisor:
                 # `preempt_fast_limit` consecutive sub-min-uptime preemption
                 # exits restart for free; after that, normal backoff.
                 consecutive_fast_crashes = 0
+                consecutive_fast_fatals = 0
                 if uptime >= self.min_uptime_s:
                     consecutive_fast_preempts = 0
                 else:
@@ -156,6 +196,7 @@ class Supervisor:
                         return code
             else:
                 consecutive_fast_preempts = 0
+                consecutive_fast_fatals = 0
                 if uptime >= self.min_uptime_s:
                     backoff = 0.0
                     consecutive_fast_crashes = 0

@@ -7,9 +7,13 @@ two seams where the real failures happen, without monkeypatching internals:
 
 - `detector._fetch_image_bytes` calls `await on_fetch(url)` — may raise a
   connection error, sleep (slow CDN), or substitute malformed bytes;
-- the MicroBatcher's worker thread calls `on_engine_batch(n)` right before
-  `engine.detect` — may raise (XLA error, preempted device) or hang
-  (wedged device call; the watchdog's reason to exist).
+- the MicroBatcher's worker thread calls `on_engine_batch(images)` right
+  before `engine.detect` (and again on every bisect-retry sub-batch) — may
+  raise (XLA error, preempted device, poison tag) or hang (wedged device
+  call; the watchdog's reason to exist);
+- the engine's dispatch and shard-probe paths call `on_engine_dispatch` /
+  `on_shard_probe` — device-shaped faults (OOM, dead shard) with the
+  status markers the failure classifier keys on.
 
 Activation is explicit: either the `inject(...)` context manager (tests) or
 `maybe_activate_from_env()` reading `SPOTTER_TPU_FAULTS` (e.g.
@@ -17,10 +21,24 @@ Activation is explicit: either the `inject(...)` context manager (tests) or
 plan is active every hook is a single global None check — zero cost on the
 production path.
 
-Counters (`fetch_error=N`, `engine_error=N`, `malformed_image=N`) arm the
-next N occurrences; `-1` means "every one". Durations (`fetch_delay_s`,
-`engine_hang_s`) apply to every call while the plan is active; a hang waits
-on `plan.release` so a test can un-wedge the engine deterministically.
+Counters (`fetch_error=N`, `engine_error=N`, `malformed_image=N`,
+`engine_oom=N`) arm the next N occurrences; `-1` means "every one".
+Durations (`fetch_delay_s`, `engine_hang_s`) apply to every call while the
+plan is active; a hang waits on `plan.release` so a test can un-wedge the
+engine deterministically.
+
+Engine fault domain (ISSUE 4) adds three injections at the engine seams:
+
+- `poison_item=1` enables poison checking: any image tagged with
+  `poison_image(img)` raises on every engine call whose batch contains it —
+  exactly the "this input deterministically breaks its batch" shape the
+  MicroBatcher's bisect-retry isolates;
+- `engine_oom=N` arms N dispatch-time failures carrying the
+  RESOURCE_EXHAUSTED marker (the engine's bucket-downgrade retry target);
+- `shard_dead=<device_id>` makes that device fail the engine's shard
+  health probe AND any dispatch placing work on it, with the DATA_LOSS /
+  device-halted markers the fatal classifier keys on — the degraded-dp
+  rebuild scenario, runnable on CPU virtual devices.
 """
 
 import asyncio
@@ -33,6 +51,10 @@ FAULTS_ENV = "SPOTTER_TPU_FAULTS"
 
 MALFORMED_BYTES = b"\x00\x01not-an-image\xff"
 
+# Attribute set on a PIL image by `poison_image()`; the engine-batch hook
+# raises whenever a tagged image is co-batched (poison_item plans only).
+POISON_ATTR = "_spotter_tpu_poison"
+
 
 @dataclass
 class FaultPlan:
@@ -41,6 +63,11 @@ class FaultPlan:
     malformed_image: int = 0
     engine_error: int = 0
     engine_hang_s: float = 0.0
+    # ISSUE 4 engine fault domain: poison tagging on/off, armed device-OOM
+    # count, and the device id whose shard is "dead" (-1 = none)
+    poison_item: int = 0
+    engine_oom: int = 0
+    shard_dead: int = -1
     # set() to un-wedge hanging engine calls early (tests)
     release: threading.Event = field(default_factory=threading.Event)
     _lock: threading.Lock = field(default_factory=threading.Lock)
@@ -96,6 +123,9 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "malformed_image",
             "engine_error",
             "engine_hang_s",
+            "poison_item",
+            "engine_oom",
+            "shard_dead",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
         try:
@@ -123,12 +153,57 @@ async def on_fetch(url: str) -> bytes | None:
     return None
 
 
-def on_engine_batch(n_images: int) -> None:
-    """Batcher worker-thread hook, called just before engine.detect."""
+def poison_image(image):
+    """Tag a PIL image as poisonous: while a `poison_item` plan is active,
+    every engine call whose batch contains it raises (so bisect-retry has a
+    deterministic target). Returns the image for chaining."""
+    setattr(image, POISON_ATTR, True)
+    return image
+
+
+def on_engine_batch(images: list) -> None:
+    """Batcher worker-thread hook, called just before engine.detect — on the
+    first attempt AND on every bisect-retry sub-batch, so a poison tag keeps
+    failing exactly the subsets that contain it."""
     plan = _active
     if plan is None:
         return
     if plan.engine_hang_s > 0:
         plan.release.wait(plan.engine_hang_s)
     if plan._consume("engine_error"):
-        raise RuntimeError(f"injected engine failure (batch of {n_images})")
+        raise RuntimeError(f"injected engine failure (batch of {len(images)})")
+    if plan.poison_item and any(
+        getattr(im, POISON_ATTR, False) for im in images
+    ):
+        raise RuntimeError(
+            f"injected poison image broke its batch (batch of {len(images)})"
+        )
+
+
+def on_engine_dispatch(n_images: int, device_ids: list) -> None:
+    """Engine dispatch hook (inside detect, after staging): device-shaped
+    faults with the status markers the failure classifier keys on."""
+    plan = _active
+    if plan is None:
+        return
+    if plan.shard_dead >= 0 and plan.shard_dead in device_ids:
+        raise RuntimeError(
+            f"injected shard loss: DATA_LOSS: device {plan.shard_dead} halted "
+            f"(batch of {n_images})"
+        )
+    if plan._consume("engine_oom"):
+        raise RuntimeError(
+            f"injected device OOM: RESOURCE_EXHAUSTED while allocating batch "
+            f"of {n_images}"
+        )
+
+
+def on_shard_probe(device_id: int) -> None:
+    """Engine shard-health-probe hook: the dead shard fails its ping."""
+    plan = _active
+    if plan is None:
+        return
+    if plan.shard_dead >= 0 and device_id == plan.shard_dead:
+        raise RuntimeError(
+            f"injected shard loss: device {device_id} halted (probe)"
+        )

@@ -181,3 +181,74 @@ def test_drain_flushes_then_rejects():
             await batcher.submit(_img())
 
     asyncio.run(run())
+
+
+def test_poison_max_splits_env(monkeypatch):
+    """SPOTTER_TPU_POISON_MAX_SPLITS defaults and env override."""
+    from spotter_tpu.engine.errors import DEFAULT_POISON_MAX_SPLITS
+
+    engine = FakeEngine()
+    assert _batcher(engine).poison_max_splits == DEFAULT_POISON_MAX_SPLITS
+    monkeypatch.setenv("SPOTTER_TPU_POISON_MAX_SPLITS", "2")
+    assert _batcher(engine).poison_max_splits == 2
+
+
+def test_two_poisons_both_isolated():
+    """Bisect isolation handles more than one poison per batch: both fail
+    with PoisonImageError, both innocents succeed, breaker stays closed."""
+    from spotter_tpu.engine.errors import PoisonImageError
+
+    engine = FakeEngine()
+    breaker = CircuitBreaker(threshold=2, metrics=engine.metrics)
+    batcher = _batcher(engine, max_batch=4, max_delay_ms=100.0, breaker=breaker)
+    images = [_img() for _ in range(4)]
+    faults.poison_image(images[0])
+    faults.poison_image(images[3])
+
+    async def run():
+        with faults.inject(poison_item=1):
+            results = await asyncio.gather(
+                *(batcher.submit(im) for im in images), return_exceptions=True
+            )
+        await batcher.stop()
+        return results
+
+    results = asyncio.run(run())
+    assert isinstance(results[0], PoisonImageError)
+    assert isinstance(results[3], PoisonImageError)
+    assert results[1] == DETS and results[2] == DETS
+    assert breaker.state == CircuitBreaker.CLOSED
+    assert engine.metrics.snapshot()["poison_isolated_total"] == 2
+
+
+def test_splits_budget_bounds_isolation_depth():
+    """With a 1-deep split budget a poisoned batch of 4 can only reach
+    2-image sub-batches: the poisoned half fails raw (nothing isolated to a
+    single image), the clean half still succeeds."""
+    engine = FakeEngine()
+    breaker = CircuitBreaker(threshold=100, metrics=engine.metrics)
+    batcher = _batcher(
+        engine, max_batch=4, max_delay_ms=100.0, breaker=breaker, poison_max_splits=1
+    )
+    images = [_img() for _ in range(4)]
+    faults.poison_image(images[1])
+
+    async def run():
+        with faults.inject(poison_item=1):
+            results = await asyncio.gather(
+                *(batcher.submit(im) for im in images), return_exceptions=True
+            )
+        await batcher.stop()
+        return results
+
+    results = asyncio.run(run())
+    from spotter_tpu.engine.errors import PoisonImageError
+
+    # poisoned half [0, 1] fails (raw, not PoisonImageError); clean half succeeds
+    assert isinstance(results[0], RuntimeError)
+    assert isinstance(results[1], RuntimeError)
+    assert not isinstance(results[0], PoisonImageError)
+    assert results[2] == DETS and results[3] == DETS
+    snap = engine.metrics.snapshot()
+    assert snap["poison_isolated_total"] == 0
+    assert snap["batch_retries_total"] == 1

@@ -1,9 +1,14 @@
-"""Chaos suite (ISSUE 1 acceptance): under injected fetch failures, engine
-exceptions, and a simulated engine hang, no request awaits forever — every
-caller gets a result, a structured error, or a shed response within its
-deadline, and the pump keeps serving subsequent traffic. Faults come from
-spotter_tpu/testing/faults.py, the same harness a chaos-staging server arms
-via SPOTTER_TPU_FAULTS."""
+"""Chaos suite (ISSUE 1 + ISSUE 4 acceptance): under injected fetch
+failures, engine exceptions, and a simulated engine hang, no request awaits
+forever — every caller gets a result, a structured error, or a shed
+response within its deadline, and the pump keeps serving subsequent
+traffic. The engine-fault-domain tests (ISSUE 4) add: a poisonous item is
+bisect-isolated so co-batched innocents succeed and the breaker stays
+closed; an injected device OOM recovers via the bucket-downgrade retry with
+zero client-visible errors; an injected dead shard under dp=2 rebuilds the
+engine at dp=1 in place (no process exit) with /healthz reporting the
+degradation. Faults come from spotter_tpu/testing/faults.py, the same
+harness a chaos-staging server arms via SPOTTER_TPU_FAULTS."""
 
 import asyncio
 import time
@@ -344,14 +349,241 @@ def test_server_drain_hook():
 
 
 def test_faults_env_activation(monkeypatch):
-    monkeypatch.setenv(faults.FAULTS_ENV, "fetch_error=2,engine_hang_s=1.5")
+    monkeypatch.setenv(
+        faults.FAULTS_ENV, "fetch_error=2,engine_hang_s=1.5,engine_oom=1,shard_dead=3"
+    )
     plan = faults.maybe_activate_from_env()
     try:
         assert plan.fetch_error == 2
         assert plan.engine_hang_s == 1.5
+        assert plan.engine_oom == 1
+        assert plan.shard_dead == 3
         assert faults.active() is plan
     finally:
         faults._active = None
     monkeypatch.setenv(faults.FAULTS_ENV, "bogus_fault=1")
     with pytest.raises(ValueError):
         faults.maybe_activate_from_env()
+
+
+# --- engine fault domain (ISSUE 4) -------------------------------------------
+
+
+def test_poison_item_isolated_innocents_succeed_breaker_closed():
+    """Acceptance: a 1-of-8 poison_item injection under concurrent load —
+    every non-poison request in the batch succeeds, exactly the poison
+    request fails with PoisonImageError, and the breaker stays CLOSED."""
+    from spotter_tpu.engine.errors import PoisonImageError
+
+    engine = FakeEngine()
+    engine.batch_buckets = (1, 2, 4, 8)
+    breaker = CircuitBreaker(threshold=2, metrics=engine.metrics)
+    batcher = MicroBatcher(engine, max_batch=8, max_delay_ms=100.0, breaker=breaker)
+    images = [_img() for _ in range(8)]
+    faults.poison_image(images[3])
+
+    async def run():
+        with faults.inject(poison_item=1):
+            results = await asyncio.gather(
+                *(batcher.submit(im) for im in images), return_exceptions=True
+            )
+        await batcher.stop()
+        return results
+
+    results = asyncio.run(run())
+    poison_failures = [r for r in results if isinstance(r, PoisonImageError)]
+    successes = [r for r in results if not isinstance(r, BaseException)]
+    assert len(poison_failures) == 1 and isinstance(results[3], PoisonImageError)
+    assert len(successes) == 7 and all(r == DETS for r in successes)
+    assert breaker.state == CircuitBreaker.CLOSED
+    snap = engine.metrics.snapshot()
+    assert snap["poison_isolated_total"] == 1
+    assert snap["batch_retries_total"] >= 1
+    assert snap["errors_total"] == 1
+
+
+def test_isolated_poison_never_opens_breaker_but_dead_engine_does():
+    """Satellite: poison isolation x CircuitBreaker interplay. Repeated
+    isolated poisons must not open the breaker; a genuinely failing engine
+    (every co-batched item fails, splits included) still must."""
+    engine = FakeEngine()
+    engine.batch_buckets = (1, 2, 4)
+    breaker = CircuitBreaker(threshold=2, metrics=engine.metrics)
+    batcher = MicroBatcher(engine, max_batch=4, max_delay_ms=100.0, breaker=breaker)
+
+    async def poison_round():
+        images = [_img() for _ in range(4)]
+        faults.poison_image(images[0])
+        with faults.inject(poison_item=1):
+            return await asyncio.gather(
+                *(batcher.submit(im) for im in images), return_exceptions=True
+            )
+
+    async def run():
+        # threshold-2 breaker survives 3 consecutive poisoned batches …
+        for _ in range(3):
+            results = await poison_round()
+            assert sum(1 for r in results if isinstance(r, BaseException)) == 1
+            assert breaker.state == CircuitBreaker.CLOSED
+        # … but an engine that fails every item (bisect can't find an
+        # innocent) trips it at the threshold
+        engine.broken = True
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="engine down"):
+                await batcher.submit(_img())
+        assert breaker.state == CircuitBreaker.OPEN
+        await batcher.stop()
+
+    asyncio.run(run())
+    snap = engine.metrics.snapshot()
+    assert snap["poison_isolated_total"] == 3
+
+
+def test_poison_isolation_disabled_fails_whole_batch():
+    """SPOTTER_TPU_POISON_MAX_SPLITS<=0 restores all-or-nothing batches —
+    and the whole-batch failure counts against the breaker."""
+    engine = FakeEngine()
+    engine.batch_buckets = (1, 2, 4)
+    breaker = CircuitBreaker(threshold=1, metrics=engine.metrics)
+    batcher = MicroBatcher(
+        engine, max_batch=4, max_delay_ms=100.0, breaker=breaker, poison_max_splits=0
+    )
+    images = [_img() for _ in range(4)]
+    faults.poison_image(images[1])
+
+    async def run():
+        with faults.inject(poison_item=1):
+            results = await asyncio.gather(
+                *(batcher.submit(im) for im in images), return_exceptions=True
+            )
+        await batcher.stop()
+        return results
+
+    results = asyncio.run(run())
+    assert all(isinstance(r, RuntimeError) for r in results)
+    assert breaker.state == CircuitBreaker.OPEN
+    assert engine.metrics.snapshot()["poison_isolated_total"] == 0
+
+
+@pytest.fixture(scope="module")
+def tiny_built():
+    """A real (tiny) RT-DETR BuiltDetector: the OOM-downgrade and dead-shard
+    scenarios need the real InferenceEngine classify/recover path, which the
+    FakeEngine can't exercise."""
+    import jax
+
+    from spotter_tpu.engine.engine import BuiltDetector
+    from spotter_tpu.models.rtdetr import RTDetrDetector
+    from spotter_tpu.models.zoo import tiny_rtdetr_config
+    from spotter_tpu.ops.preprocess import PreprocessSpec
+
+    cfg = tiny_rtdetr_config()
+    module = RTDetrDetector(cfg)
+    params = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, 64, 64, 3), np.float32)
+    )["params"]
+    return BuiltDetector(
+        model_name="tiny-chaos",
+        module=module,
+        params=params,
+        preprocess_spec=PreprocessSpec(mode="fixed", size=(64, 64)),
+        postprocess="sigmoid_topk",
+        id2label=cfg.id2label_dict,
+        num_top_queries=10,
+    )
+
+
+def test_engine_oom_once_downgrades_bucket_zero_client_errors(tiny_built):
+    """Acceptance: an engine_oom_once injection at the largest bucket
+    recovers via the bucket-downgrade retry — the halves land in the
+    next-smaller bucket — with zero client-visible errors."""
+    from spotter_tpu.engine.engine import InferenceEngine
+
+    engine = InferenceEngine(tiny_built, threshold=0.0, batch_buckets=(2, 4))
+    breaker = CircuitBreaker(threshold=2, metrics=engine.metrics)
+    batcher = MicroBatcher(engine, max_batch=4, max_delay_ms=100.0, breaker=breaker)
+    rng = np.random.default_rng(7)
+    images = [
+        Image.fromarray(rng.integers(0, 255, (48, 64, 3), dtype=np.uint8))
+        for _ in range(4)
+    ]
+
+    async def run():
+        with faults.inject(engine_oom=1):
+            results = await asyncio.gather(*(batcher.submit(im) for im in images))
+        await batcher.stop()
+        return results
+
+    results = asyncio.run(run())
+    assert len(results) == 4
+    assert all(isinstance(r, list) and len(r) > 0 for r in results)
+    snap = engine.metrics.snapshot()
+    assert snap["batch_retries_total"] >= 1
+    assert snap["errors_total"] == 0
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+def test_shard_dead_rebuilds_degraded_dp1_no_process_exit(tiny_built):
+    """Acceptance (dp=2 virtual devices): injected shard_dead -> the engine
+    rebuilds at dp=1 WITHOUT a process exit, /healthz reports the
+    degradation, and post-rebuild requests succeed."""
+    import jax
+
+    from spotter_tpu.engine.engine import InferenceEngine
+    from spotter_tpu.parallel.mesh import make_mesh
+
+    devs = jax.devices()[:2]
+    mesh = make_mesh(dp=2, tp=1, devices=devs)
+    engine = InferenceEngine(
+        tiny_built, threshold=0.0, batch_buckets=(2, 4), mesh=mesh
+    )
+    assert engine.dp == 2
+    breaker = CircuitBreaker(threshold=10, metrics=engine.metrics)
+    batcher = MicroBatcher(engine, max_delay_ms=5.0, breaker=breaker)
+    detector = AmenitiesDetector(engine, batcher, _client_returning_image())
+    exit_codes: list[int] = []
+
+    async def run():
+        app = make_app(detector=detector, fatal_exit_cb=exit_codes.append)
+        async with TestClient(TestServer(app)) as client:
+            payload = {"image_urls": ["http://e.com/a.jpg"]}
+            ok = await client.post("/detect", json=payload)
+            assert ok.status == 200
+            health = await (await client.get("/healthz")).json()
+            assert health["dp"] == 2 and health["dp_degraded"] is None
+
+            with faults.inject(shard_dead=devs[1].id):
+                # this request's batch dies with the shard; its error is
+                # contained per-image (the pool layer replays such failures)
+                broken = await client.post("/detect", json=payload)
+                assert broken.status == 200
+                body = await broken.json()
+                assert "error" in body["images"][0]
+
+                # the batcher's degraded rebuild runs in the batch task;
+                # wait for the generation bump instead of sleeping blind
+                for _ in range(600):
+                    if engine.generation >= 1:
+                        break
+                    await asyncio.sleep(0.05)
+                assert engine.generation >= 1
+                assert engine.dp == 1
+
+                after = await client.post("/detect", json=payload)
+                assert after.status == 200
+                assert "labeled_image_base64" in (await after.json())["images"][0]
+
+                health = await client.get("/healthz")
+                assert health.status == 200  # degraded but READY (still serving)
+                hbody = await health.json()
+                assert hbody["status"] == "degraded"
+                assert hbody["dp_degraded"] == {"from": 2, "to": 1}
+                startup = await (await client.get("/startupz")).json()
+                assert startup["state"] == "ready"
+                metrics = await (await client.get("/metrics")).json()
+                assert metrics["engine_rebuilds_total"] == 1
+                assert metrics["fatal_engine_errors_total"] >= 1
+                assert metrics["dp_degraded"] == {"from": 2, "to": 1}
+
+    asyncio.run(run())
+    assert exit_codes == []  # degraded in place, never exited

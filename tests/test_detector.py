@@ -219,3 +219,103 @@ def test_validation_error_rejects_bad_payload():
             await detector.detect({"image_urls": ["not a url"]})
 
     asyncio.run(run())
+
+
+def _status_error_fetch(status: int, counter: dict):
+    """A fetch side_effect raising a real httpx.HTTPStatusError."""
+
+    def fail(url):
+        counter["n"] += 1
+        req = httpx.Request("GET", url)
+        resp = httpx.Response(status, request=req)
+        raise httpx.HTTPStatusError(f"{status}", request=req, response=resp)
+
+    return fail
+
+
+def test_404_fails_fast_without_retries():
+    """Satellite (ISSUE 4): a deterministic 4xx must not be retried through
+    3 attempts of backoff — one fetch, one structured error."""
+    calls = {"n": 0}
+    detector, _ = _detector([], fetch=_status_error_fetch(404, calls))
+
+    async def run():
+        return await detector.detect({"image_urls": ["http://example.com/gone.jpg"]})
+
+    resp = asyncio.run(run())
+    (r,) = resp.images
+    assert isinstance(r, DetectionErrorResult)
+    assert r.error.startswith("HTTP Error:")
+    assert calls["n"] == 1  # NOT 3: non-retryable status
+
+
+def test_5xx_still_retried_three_times():
+    calls = {"n": 0}
+    detector, _ = _detector([], fetch=_status_error_fetch(503, calls))
+
+    async def run():
+        return await detector.detect({"image_urls": ["http://example.com/busy.jpg"]})
+
+    resp = asyncio.run(run())
+    (r,) = resp.images
+    assert isinstance(r, DetectionErrorResult)
+    assert calls["n"] == 3  # transient status keeps the reference retry contract
+
+
+def test_fetch_max_bytes_cap_rejects_without_retry(monkeypatch):
+    """SPOTTER_TPU_FETCH_MAX_BYTES: an oversized body is a typed, fast,
+    non-retried per-image error — not a host-memory liability."""
+    monkeypatch.setenv("SPOTTER_TPU_FETCH_MAX_BYTES", "64")
+    calls = {"n": 0}
+
+    def big(url):
+        calls["n"] += 1
+        resp = AsyncMock()
+        resp.content = b"x" * 1024
+        resp.raise_for_status = lambda: None
+        return resp
+
+    detector, _ = _detector([], fetch=big)
+
+    async def run():
+        return await detector.detect({"image_urls": ["http://example.com/huge.jpg"]})
+
+    resp = asyncio.run(run())
+    (r,) = resp.images
+    assert isinstance(r, DetectionErrorResult)
+    assert r.error.startswith("Fetch Error:")
+    assert "SPOTTER_TPU_FETCH_MAX_BYTES" in r.error
+    assert calls["n"] == 1
+
+
+def test_decode_bomb_guard_is_per_image_error(monkeypatch):
+    """SPOTTER_TPU_MAX_IMAGE_PIXELS rejects a decode bomb before convert()
+    decodes it; co-requested small images still succeed."""
+    monkeypatch.setenv("SPOTTER_TPU_MAX_IMAGE_PIXELS", "1000")  # 64x48 > 1000 px
+
+    def mixed(url):
+        resp = AsyncMock()
+        # "bomb" is only big by pixel count; tiny stays under the cap
+        resp.content = _image_bytes() if "bomb" in url else _image_bytes(w=20, h=20)
+        resp.raise_for_status = lambda: None
+        return resp
+
+    detector, _ = _detector(
+        [{"label": "oven", "score": 0.9, "box": [1, 1, 9, 9]}], fetch=mixed
+    )
+
+    async def run():
+        return await detector.detect(
+            {
+                "image_urls": [
+                    "http://example.com/bomb.jpg",
+                    "http://example.com/ok.jpg",
+                ]
+            }
+        )
+
+    resp = asyncio.run(run())
+    bomb, ok = resp.images
+    assert isinstance(bomb, DetectionErrorResult)
+    assert "SPOTTER_TPU_MAX_IMAGE_PIXELS" in bomb.error
+    assert isinstance(ok, DetectionSuccessResult)

@@ -525,3 +525,65 @@ def test_preemption_env_source_construction(monkeypatch, tmp_path):
     assert watcher.file_source == str(tmp_path / "m")
     assert watcher.url_source is None
     assert watcher.poll_s == 0.5
+
+
+def test_supervisor_fatal_engine_exit_restarts_immediately(tmp_path):
+    """ISSUE 4: FATAL_ENGINE_EXIT_CODE (85) gets an immediate warm restart
+    — no crash backoff, no crash-loop debt — but a device that STAYS dead
+    falls back to backoff after the fast limit, like persistent preemption."""
+    import sys
+
+    from spotter_tpu.engine.errors import FATAL_ENGINE_EXIT_CODE
+    from spotter_tpu.serving.supervisor import Supervisor
+
+    counter = tmp_path / "count"
+    script = (
+        "import pathlib, sys\n"
+        f"p = pathlib.Path({str(counter)!r})\n"
+        "n = int(p.read_text()) + 1 if p.exists() else 1\n"
+        "p.write_text(str(n))\n"
+        f"sys.exit({FATAL_ENGINE_EXIT_CODE} if n <= 2 else 0)\n"
+    )
+    sup = Supervisor(
+        [sys.executable, "-c", script],
+        backoff_base_s=5.0,  # immediate restarts must never hit this
+        min_uptime_s=5.0,  # every exit here counts as "fast"
+        crash_loop_limit=1,  # fatal-engine exits must NOT trip the circuit
+        preempt_fast_limit=3,
+    )
+    started = time.monotonic()
+    assert sup.run() == 0
+    assert sup.restarts_total == 2
+    assert time.monotonic() - started < 4.0  # no 5 s backoff was paid
+
+
+def test_supervisor_persistent_fatal_engine_falls_back_to_backoff(tmp_path):
+    """A chip that stays dead (exit 85 forever-fast) must not hot-loop
+    spawn->fatal->exit: past the fast limit the exponential backoff applies."""
+    import sys
+
+    from spotter_tpu.engine.errors import FATAL_ENGINE_EXIT_CODE
+    from spotter_tpu.serving.supervisor import Supervisor
+
+    counter = tmp_path / "count"
+    script = (
+        "import pathlib, sys\n"
+        f"p = pathlib.Path({str(counter)!r})\n"
+        "n = int(p.read_text()) + 1 if p.exists() else 1\n"
+        "p.write_text(str(n))\n"
+        f"sys.exit({FATAL_ENGINE_EXIT_CODE} if n <= 4 else 0)\n"
+    )
+    sup = Supervisor(
+        [sys.executable, "-c", script],
+        backoff_base_s=0.2,
+        backoff_max_s=0.3,
+        min_uptime_s=5.0,
+        crash_loop_limit=2,  # < the 4 fatal exits: must NOT trip
+        preempt_fast_limit=2,
+    )
+    started = time.monotonic()
+    assert sup.run() == 0
+    elapsed = time.monotonic() - started
+    assert sup.restarts_total == 4
+    # exits 3 and 4 were past the fast limit: backoffs 0.2 + 0.3 = 0.5 s
+    assert elapsed >= 0.45

@@ -65,3 +65,27 @@ def test_batch_images_stacks_and_sizes():
     assert pixels.shape == (2, 640, 640, 3)
     assert masks.shape == (2, 640, 640)
     np.testing.assert_array_equal(sizes, [[480, 640], [100, 200]])
+
+
+def test_decode_bomb_guard_blocks_oversized_images(monkeypatch):
+    """SPOTTER_TPU_MAX_IMAGE_PIXELS (ISSUE 4 satellite): both DecodePool
+    preprocess paths reject an over-cap image before any resize; <=0
+    disables the guard."""
+    import pytest
+
+    from spotter_tpu.ops.preprocess import (
+        ImageTooLargeError,
+        decode_resize_uint8,
+    )
+
+    img = Image.fromarray(np.zeros((40, 50, 3), np.uint8))  # 2000 px
+    monkeypatch.setenv("SPOTTER_TPU_MAX_IMAGE_PIXELS", "1999")
+    with pytest.raises(ImageTooLargeError, match="decode-bomb guard"):
+        preprocess_image(img, RTDETR_SPEC)
+    with pytest.raises(ImageTooLargeError, match="decode-bomb guard"):
+        decode_resize_uint8(img, RTDETR_SPEC)
+    monkeypatch.setenv("SPOTTER_TPU_MAX_IMAGE_PIXELS", "2000")
+    pixels, _, _ = preprocess_image(img, RTDETR_SPEC)
+    assert pixels.shape == (640, 640, 3)
+    monkeypatch.setenv("SPOTTER_TPU_MAX_IMAGE_PIXELS", "0")  # disabled
+    preprocess_image(img, RTDETR_SPEC)
