@@ -577,6 +577,235 @@ def chaos_serve_bench(args) -> int:
     return 0
 
 
+def cache_bench(args) -> int:
+    """Caching tier, measured not asserted (ISSUE 5): the REAL detector +
+    MicroBatcher + result-cache/coalescing plumbing under a Zipf-distributed
+    duplicate-heavy URL workload (the shape of listing-photo traffic). The
+    engine is synthetic (fixed per-batch service time — the quantity under
+    test is the cache tier, not the forward pass; CPU ok) and the fetch is a
+    canned in-process client with a configurable latency, so both halves the
+    cache short-circuits are represented.
+
+    Two identical load phases — cache OFF then cache ON — report goodput and
+    the ON/OFF ratio; a sequential measurement phase then pins the hit-path
+    and miss-path p50 exactly (every probe is a known hit / known miss, no
+    concurrency smearing the classification). Prints ONE JSON line with
+    goodput, hit rate, coalesce rate, and hit/miss p50 as parsed fields.
+    Exit 0 requires (at >= 50% duplicates) goodput >= 2x cache-off and
+    hit p50 < 5 ms — the acceptance gate.
+    """
+    import asyncio
+    from io import BytesIO
+
+    from PIL import Image
+
+    from spotter_tpu.caching.result_cache import ResultCache
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.engine.metrics import Metrics
+    from spotter_tpu.serving.detector import AmenitiesDetector
+
+    service_s = args.cache_service_ms / 1000.0
+    fetch_s = args.cache_fetch_ms / 1000.0
+    n_requests = args.cache_requests
+    n_unique = args.cache_unique
+    max_batch = 8
+
+    class SyntheticEngine:
+        def __init__(self) -> None:
+            self.metrics = Metrics()
+            self.batch_buckets = (max_batch,)
+            self.threshold = 0.5
+            self.calls = 0
+
+        def detect(self, images):
+            self.calls += 1
+            time.sleep(service_s)
+            return [
+                [{"label": "tv", "score": 0.9, "box": [1.0, 1.0, 9.0, 9.0]}]
+                for _ in images
+            ]
+
+    def jpeg_for(idx: int) -> bytes:
+        rng = np.random.default_rng(idx)
+        img = Image.fromarray(rng.integers(0, 255, (24, 24, 3), dtype=np.uint8))
+        buf = BytesIO()
+        img.save(buf, format="JPEG")
+        return buf.getvalue()
+
+    bodies = {f"http://cdn/img-{i}.jpg": jpeg_for(i) for i in range(n_unique)}
+    # out-of-workload URLs for the exact miss-path probes
+    probes = {f"http://cdn/probe-{i}.jpg": jpeg_for(10_000 + i) for i in range(10)}
+    bodies.update(probes)
+
+    class CannedClient:
+        def __init__(self) -> None:
+            self.fetches = 0
+
+        async def get(self, url: str):
+            self.fetches += 1
+            if fetch_s:
+                await asyncio.sleep(fetch_s)
+            body = bodies[url]
+
+            class _Resp:
+                content = body
+
+                def raise_for_status(self):
+                    pass
+
+            return _Resp()
+
+        async def aclose(self):
+            pass
+
+    # ranked Zipf over the unique URLs: p(rank) ∝ 1/rank^s — the skewed
+    # duplication profile DeepServe argues dominates real request streams
+    ranks = np.arange(1, n_unique + 1, dtype=np.float64)
+    weights = ranks ** -args.cache_zipf
+    weights /= weights.sum()
+    rng = np.random.default_rng(0)
+    workload = [
+        f"http://cdn/img-{i}.jpg"
+        for i in rng.choice(n_unique, size=n_requests, p=weights)
+    ]
+    duplicate_fraction = 1.0 - len(set(workload)) / len(workload)
+
+    def build(with_cache: bool):
+        engine = SyntheticEngine()
+        cache = (
+            ResultCache(
+                max_bytes=int(args.cache_budget_mb * 1024 * 1024),
+                metrics=engine.metrics,
+            )
+            if with_cache
+            else None
+        )
+        det = AmenitiesDetector(
+            engine,
+            MicroBatcher(engine, max_batch=max_batch, max_delay_ms=2.0),
+            CannedClient(),
+            cache=cache,
+        )
+        return det, engine
+
+    async def load_phase(det) -> tuple[float, list[float]]:
+        lats: list[float] = []
+        cursor = {"i": 0}
+
+        async def worker() -> None:
+            while cursor["i"] < n_requests:
+                i = cursor["i"]
+                cursor["i"] += 1
+                t0 = time.perf_counter()
+                await det.detect({"image_urls": [workload[i]]})
+                lats.append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker() for _ in range(args.cache_concurrency)))
+        return time.perf_counter() - t0, lats
+
+    async def probe_phase(det) -> tuple[float, float]:
+        """Sequential known-hit / known-miss probes: exact path p50s."""
+        hot = workload[0]
+        await det.detect({"image_urls": [hot]})  # ensure it is cached
+        hits: list[float] = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            await det.detect({"image_urls": [hot]})
+            hits.append(time.perf_counter() - t0)
+        misses: list[float] = []
+        for url in probes:
+            t0 = time.perf_counter()
+            await det.detect({"image_urls": [url]})
+            misses.append(time.perf_counter() - t0)
+        return float(np.median(hits)) * 1e3, float(np.median(misses)) * 1e3
+
+    async def drive():
+        det_off, eng_off = build(with_cache=False)
+        off_elapsed, off_lats = await load_phase(det_off)
+        await det_off.aclose()
+
+        det_on, eng_on = build(with_cache=True)
+        on_elapsed, on_lats = await load_phase(det_on)
+        hit_p50_ms, miss_p50_ms = await probe_phase(det_on)
+        snap = eng_on.metrics.snapshot()
+        cache_stats = det_on.cache.stats()
+        fetches_on = det_on.client.fetches
+        await det_on.aclose()
+        return {
+            "off": (off_elapsed, off_lats, det_off.client.fetches, eng_off.calls),
+            "on": (on_elapsed, on_lats, fetches_on, eng_on.calls),
+            "snap": snap,
+            "cache_stats": cache_stats,
+            "hit_p50_ms": hit_p50_ms,
+            "miss_p50_ms": miss_p50_ms,
+        }
+
+    out = asyncio.run(drive())
+    off_elapsed, off_lats, off_fetches, off_calls = out["off"]
+    on_elapsed, on_lats, on_fetches, on_calls = out["on"]
+    snap = out["snap"]
+    goodput_off = n_requests / off_elapsed
+    goodput_on = n_requests / on_elapsed
+    ratio = goodput_on / goodput_off if goodput_off else 0.0
+    lookups = snap["cache_hits_total"] + snap["cache_misses_total"]
+    hit_rate = snap["cache_hits_total"] / lookups if lookups else 0.0
+    coalesce_rate = snap["coalesced_submits_total"] / n_requests
+    hit_p50_ms, miss_p50_ms = out["hit_p50_ms"], out["miss_p50_ms"]
+    print(
+        f"# cache: {n_requests} requests over {n_unique} Zipf(s="
+        f"{args.cache_zipf}) URLs ({duplicate_fraction:.0%} duplicates), "
+        f"service {args.cache_service_ms:.0f} ms/batch, fetch "
+        f"{args.cache_fetch_ms:.0f} ms: OFF {goodput_off:.1f} img/s "
+        f"({off_fetches} fetches, {off_calls} engine calls) -> ON "
+        f"{goodput_on:.1f} img/s ({on_fetches} fetches, {on_calls} engine "
+        f"calls) = {ratio:.2f}x; hit rate {hit_rate:.0%}, coalesce rate "
+        f"{coalesce_rate:.0%}; hit p50 {hit_p50_ms:.2f} ms vs miss p50 "
+        f"{miss_p50_ms:.2f} ms",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"result-cache goodput multiplier ({duplicate_fraction:.0%} "
+            f"duplicate Zipf workload, {n_unique} URLs; hit rate "
+            f"{hit_rate:.0%}, hit p50 {hit_p50_ms:.2f} ms / miss "
+            f"{miss_p50_ms:.2f} ms)"
+        ),
+        "value": round(ratio, 2),
+        "unit": "x_goodput_vs_cache_off",
+        "vs_baseline": None,
+        "requests": n_requests,
+        "unique_urls": n_unique,
+        "zipf_s": args.cache_zipf,
+        "duplicate_fraction": round(duplicate_fraction, 3),
+        "goodput_cache_off_ips": round(goodput_off, 1),
+        "goodput_cache_on_ips": round(goodput_on, 1),
+        "goodput_ratio_x": round(ratio, 2),
+        "load_p50_off_ms": round(float(np.median(off_lats)) * 1e3, 2),
+        "load_p50_on_ms": round(float(np.median(on_lats)) * 1e3, 2),
+        "hit_p50_ms": round(hit_p50_ms, 3),
+        "miss_p50_ms": round(miss_p50_ms, 3),
+        "hit_rate": round(hit_rate, 3),
+        "coalesce_rate": round(coalesce_rate, 3),
+        "cache_hits_total": snap["cache_hits_total"],
+        "cache_misses_total": snap["cache_misses_total"],
+        "coalesced_fetches_total": snap["coalesced_fetches_total"],
+        "coalesced_submits_total": snap["coalesced_submits_total"],
+        "cache_evictions_total": snap["cache_evictions_total"],
+        "cache_entries": out["cache_stats"]["entries"],
+        "cache_bytes": out["cache_stats"]["bytes"],
+        "fetches_cache_off": off_fetches,
+        "fetches_cache_on": on_fetches,
+        "engine_calls_cache_off": off_calls,
+        "engine_calls_cache_on": on_calls,
+    }
+    print(json.dumps(result))
+    # acceptance gate: at >= 50% duplicates the tier must pay for itself
+    if duplicate_fraction >= 0.5 and (ratio < 2.0 or hit_p50_ms >= 5.0):
+        return 1
+    return 0
+
+
 def multichip_serve_bench(args) -> int:
     """dp-sharded REAL serving path, measured not asserted (ISSUE 3): the
     engine (ingest -> H2D -> sharded forward -> fetch) over every local chip
@@ -784,6 +1013,30 @@ def main() -> int:
         "devices when XLA_FLAGS doesn't already pin a count",
     )
     parser.add_argument(
+        "--cache",
+        action="store_true",
+        help="run the caching-tier bench instead (CPU ok, model-free): "
+        "Zipf-distributed duplicate-heavy URL workload through the real "
+        "detector + result cache + coalescing; goodput vs cache-off, hit "
+        "rate, coalesce rate, hit/miss p50",
+    )
+    parser.add_argument("--cache-requests", type=int, default=600)
+    parser.add_argument("--cache-concurrency", type=int, default=16)
+    parser.add_argument(
+        "--cache-unique", type=int, default=48,
+        help="distinct URLs in the Zipf workload (duplication knob: fewer "
+        "URLs or a larger exponent = more duplicates)",
+    )
+    parser.add_argument(
+        "--cache-zipf", type=float, default=1.2,
+        help="Zipf exponent s for the URL popularity distribution",
+    )
+    # 25 ms per batch-8 engine call ~ the measured 264 img/s/chip R101 pace
+    # (BENCH_r05) — the honest relative cost of the work a hit skips
+    parser.add_argument("--cache-service-ms", type=float, default=25.0)
+    parser.add_argument("--cache-fetch-ms", type=float, default=2.0)
+    parser.add_argument("--cache-budget-mb", type=float, default=64.0)
+    parser.add_argument(
         "--multichip-serve",
         action="store_true",
         help="run the dp-sharded serving bench instead: aggregate img/s over "
@@ -807,6 +1060,8 @@ def main() -> int:
         return overload_bench(args)
     if args.failover:
         return failover_bench(args)
+    if args.cache:
+        return cache_bench(args)
     if args.chaos_serve:
         # before the jax import below: chaos_serve_bench sets the virtual
         # device count env first

@@ -1,0 +1,43 @@
+"""Content-addressed result cache + single-flight coalescing (ISSUE 5).
+
+Real spotter traffic (amenity detection over listing-photo URLs) is heavily
+duplicated, and the RT-DETR serving path is deterministic per
+(model, image bytes, threshold) — so memoization in front of the engine is
+exact, not approximate (DeepServe makes the same argument for serverless
+LLM serving, PAPERS.md). Two cooperating pieces:
+
+- `singleflight.SingleFlight` — async in-flight coalescing: N concurrent
+  calls for the same key share ONE underlying flight, with per-waiter
+  deadline/cancellation semantics (one waiter's expiry never fails the
+  shared flight).
+- `result_cache.ResultCache` — content-addressed LRU over post-processed
+  detections (tiny — never tensors), keyed on
+  (model, sha256(image bytes), threshold bucket), with TTL + byte budget
+  and a short-TTL negative cache for deterministic failures.
+
+The whole tier is opt-in: `SPOTTER_TPU_CACHE_MAX_MB=0` (the default)
+disables it entirely and the serving path is bit-identical to a build
+without this package.
+
+Import-light on purpose (lazy, PEP 562): nothing here pulls in jax, so the
+supervisor/router processes can keep importing serving modules cheaply.
+"""
+
+_EXPORTS = {
+    "SingleFlight": "spotter_tpu.caching.singleflight",
+    "ResultCache": "spotter_tpu.caching.result_cache",
+    "CACHE_MAX_MB_ENV": "spotter_tpu.caching.result_cache",
+    "CACHE_TTL_ENV": "spotter_tpu.caching.result_cache",
+    "CACHE_NEGATIVE_TTL_ENV": "spotter_tpu.caching.result_cache",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

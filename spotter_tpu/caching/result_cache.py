@@ -1,0 +1,237 @@
+"""Content-addressed LRU result cache with TTL, byte budget, negative cache.
+
+Stores POST-PROCESSED detections (a handful of label/score/box dicts —
+tens of bytes) keyed on `(model_name, sha256(image bytes), threshold
+bucket)`; never tensors, so a generous entry count fits in a few MB and a
+hit costs a dict lookup, not an engine pass. Deterministic failures
+(non-retryable 4xx `FetchError`, `PoisonImageError`) go to a short-TTL
+negative cache so a repeat poison skips the fetch/bisect machinery instead
+of re-poisoning a batch.
+
+What is NEVER cached (enforced by the fill sites — the detector's fetch
+flight and the batcher's keyed-completion callback — which only ever pass
+the classes below in):
+- 5xx / 429 / timeouts / connect errors — retryable, the next attempt may
+  succeed;
+- admission sheds (queue full, breaker open, draining) — load state, not a
+  property of the image;
+- fatal/transient engine errors (device lost, OOM) — the degraded-dp
+  rebuild must retry them, not serve a stale verdict.
+
+Knobs: `SPOTTER_TPU_CACHE_MAX_MB` (byte budget; 0 — the default — disables
+the whole tier), `SPOTTER_TPU_CACHE_TTL_S`, `SPOTTER_TPU_CACHE_NEGATIVE_TTL_S`.
+
+Thread-safe (a lock around the OrderedDicts): lookups and fills happen on
+the event loop, but /metrics snapshots and tests touch it from other
+threads. Cache faults injected via `testing.faults` (`cache_error=N`) are
+CONTAINED here — a broken cache degrades to a miss / skipped fill, never to
+a failed request.
+"""
+
+import hashlib
+import json
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from spotter_tpu.serving.resilience import _env_float
+from spotter_tpu.testing import faults
+
+logger = logging.getLogger(__name__)
+
+CACHE_MAX_MB_ENV = "SPOTTER_TPU_CACHE_MAX_MB"
+CACHE_TTL_ENV = "SPOTTER_TPU_CACHE_TTL_S"
+CACHE_NEGATIVE_TTL_ENV = "SPOTTER_TPU_CACHE_NEGATIVE_TTL_S"
+
+DEFAULT_CACHE_MAX_MB = 0.0  # disabled: caching is an explicit deployment opt-in
+DEFAULT_CACHE_TTL_S = 600.0
+DEFAULT_CACHE_NEGATIVE_TTL_S = 30.0
+# negative entries are bounded by count (they carry an exception, not
+# detections, so the byte budget is the wrong ruler)
+MAX_NEGATIVE_ENTRIES = 4096
+
+
+def content_key(model_name: str, image_bytes: bytes, threshold: float) -> str:
+    """The content-addressed key: model + sha256(bytes) + threshold bucket.
+
+    The threshold is bucketed to 2 decimals so float formatting noise can't
+    split otherwise-identical deployments into disjoint key spaces.
+    """
+    digest = hashlib.sha256(image_bytes).hexdigest()
+    return f"{model_name}|{digest}|t{threshold:.2f}"
+
+
+def url_key(url: str) -> str:
+    """Negative-cache key for a deterministic fetch failure (content unknown)."""
+    return f"url|{url}"
+
+
+class ResultCache:
+    """LRU + TTL + byte budget over tiny detection lists, with a sidecar
+    negative cache for deterministic failures."""
+
+    def __init__(
+        self,
+        max_bytes: int,
+        ttl_s: float = DEFAULT_CACHE_TTL_S,
+        negative_ttl_s: float = DEFAULT_CACHE_NEGATIVE_TTL_S,
+        metrics=None,
+        clock=time.monotonic,
+    ) -> None:
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = ttl_s
+        self.negative_ttl_s = negative_ttl_s
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> (detections, nbytes, expires_at)
+        self._entries: OrderedDict[str, tuple[list, int, float]] = OrderedDict()
+        # key -> (exception, expires_at)
+        self._negative: OrderedDict[str, tuple[BaseException, float]] = OrderedDict()
+        self._bytes = 0
+
+    @classmethod
+    def from_env(cls, metrics=None, max_mb: Optional[float] = None) -> Optional["ResultCache"]:
+        """The serving wiring: an armed cache, or None when the tier is off
+        (`SPOTTER_TPU_CACHE_MAX_MB` unset or <= 0) — None means every caller
+        takes the exact pre-cache code path, bit-identical to today.
+        `max_mb` (the `--cache-mb` flag) overrides the env budget; the TTL
+        knobs are read from the env either way."""
+        if max_mb is None:
+            max_mb = _env_float(CACHE_MAX_MB_ENV, DEFAULT_CACHE_MAX_MB)
+        if max_mb <= 0:
+            return None
+        return cls(
+            max_bytes=int(max_mb * 1024 * 1024),
+            ttl_s=_env_float(CACHE_TTL_ENV, DEFAULT_CACHE_TTL_S),
+            negative_ttl_s=_env_float(
+                CACHE_NEGATIVE_TTL_ENV, DEFAULT_CACHE_NEGATIVE_TTL_S
+            ),
+            metrics=metrics,
+        )
+
+    # -- positive entries ----------------------------------------------------
+
+    def get(self, key: str) -> Optional[list]:
+        """Detections for `key`, or None. Counts a hit/miss; returns a COPY
+        of the stored list so no two requests share mutable state."""
+        try:
+            faults.on_cache("get", key)
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None and entry[2] <= self._clock():
+                    self._drop(key)
+                    entry = None
+                if entry is None:
+                    self._record("record_cache_miss")
+                    return None
+                self._entries.move_to_end(key)
+                self._record("record_cache_hit")
+                return [dict(d) for d in entry[0]]
+        except Exception:
+            logger.exception("result cache get(%s) failed; treating as miss", key)
+            self._record("record_cache_miss")
+            return None
+
+    def put(self, key: str, detections: list) -> None:
+        """Fill (idempotent; last writer wins). Oversized values — bigger
+        than the whole budget — are not stored."""
+        try:
+            faults.on_cache("put", key)
+            nbytes = self._estimate_nbytes(key, detections)
+            if nbytes > self.max_bytes:
+                return
+            value = [dict(d) for d in detections]
+            with self._lock:
+                if key in self._entries:
+                    self._drop(key)
+                self._entries[key] = (value, nbytes, self._clock() + self.ttl_s)
+                self._bytes += nbytes
+                evicted = 0
+                while self._bytes > self.max_bytes and self._entries:
+                    oldest = next(iter(self._entries))
+                    self._drop(oldest)
+                    evicted += 1
+                if evicted and self.metrics is not None:
+                    self.metrics.record_cache_eviction(evicted)
+                self._publish_size()
+        except Exception:
+            logger.exception("result cache put(%s) failed; skipping fill", key)
+
+    # -- negative entries ----------------------------------------------------
+
+    def get_negative(self, key: str) -> Optional[BaseException]:
+        """The cached deterministic failure for `key`, or None. The caller
+        re-raises it; expiry means the next attempt really retries."""
+        try:
+            faults.on_cache("get_negative", key)
+            with self._lock:
+                entry = self._negative.get(key)
+                if entry is None:
+                    return None
+                if entry[1] <= self._clock():
+                    del self._negative[key]
+                    return None
+                self._negative.move_to_end(key)
+                self._record("record_cache_negative_hit")
+                return entry[0]
+        except Exception:
+            logger.exception(
+                "result cache get_negative(%s) failed; treating as miss", key
+            )
+            return None
+
+    def put_negative(self, key: str, exc: BaseException) -> None:
+        try:
+            faults.on_cache("put_negative", key)
+            with self._lock:
+                self._negative[key] = (exc, self._clock() + self.negative_ttl_s)
+                self._negative.move_to_end(key)
+                while len(self._negative) > MAX_NEGATIVE_ENTRIES:
+                    self._negative.popitem(last=False)
+        except Exception:
+            logger.exception("result cache put_negative(%s) failed; skipping", key)
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """/healthz-shaped snapshot of the cache's size state."""
+        with self._lock:
+            return {
+                "enabled": True,
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "negative_entries": len(self._negative),
+                "ttl_s": self.ttl_s,
+                "negative_ttl_s": self.negative_ttl_s,
+            }
+
+    # -- internals (callers hold the lock where noted) -----------------------
+
+    def _drop(self, key: str) -> None:
+        # caller holds the lock
+        value = self._entries.pop(key, None)
+        if value is not None:
+            self._bytes -= value[1]
+
+    def _publish_size(self) -> None:
+        # caller holds the lock
+        if self.metrics is not None:
+            self.metrics.set_cache_size(len(self._entries), self._bytes)
+
+    def _record(self, method: str) -> None:
+        if self.metrics is not None:
+            getattr(self.metrics, method)()
+
+    @staticmethod
+    def _estimate_nbytes(key: str, detections: list) -> int:
+        # detections are tiny JSON-shaped dicts (label/score/box); the JSON
+        # encoding is an honest, deterministic size proxy for the budget
+        try:
+            payload = len(json.dumps(detections))
+        except (TypeError, ValueError):
+            payload = len(repr(detections))
+        return len(key) + payload + 96  # + OrderedDict/tuple overhead

@@ -1,0 +1,80 @@
+"""Async single-flight coalescing: N concurrent calls, one underlying flight.
+
+The serving stack uses this at two layers (ISSUE 5): URL-level in the
+detector (N concurrent requests for the same URL perform ONE fetch) and —
+via the MicroBatcher's keyed-submit machinery, which implements the same
+fan-out contract over its future plumbing — content-hash-level at batch
+admission (same decoded bytes already heading to the engine attach to the
+existing call instead of re-running it).
+
+Contract, in the presence of every failure mode the serving stack knows:
+
+- the flight runs in its OWN task, never under a waiter: one waiter's
+  expired `Deadline` or client disconnect (task cancellation) detaches that
+  waiter only — the flight keeps running for everyone else, and its result
+  still fills the cache;
+- a failed flight fans its exception to every attached waiter exactly once
+  (each waiter observes the same exception instance);
+- flights are keyed per-instance, not globally, so two detectors (tests,
+  replicas in one process) never share state.
+"""
+
+import asyncio
+from typing import Awaitable, Callable, Optional
+
+
+class SingleFlight:
+    """In-flight call coalescing keyed by string.
+
+    `on_coalesced` (optional) is called once per waiter that attached to an
+    existing flight instead of starting its own — the metrics hook.
+    """
+
+    def __init__(self, on_coalesced: Optional[Callable[[], None]] = None) -> None:
+        self._flights: dict[str, asyncio.Task] = {}
+        self._on_coalesced = on_coalesced
+
+    def in_flight(self, key: str) -> bool:
+        task = self._flights.get(key)
+        return task is not None and not task.done()
+
+    def __len__(self) -> int:
+        return len(self._flights)
+
+    async def run(
+        self,
+        key: str,
+        factory: Callable[[], Awaitable],
+        deadline=None,
+        what: str = "shared flight",
+    ):
+        """Await the (possibly shared) flight for `key`.
+
+        `factory` is only invoked when no flight for `key` is in progress.
+        It must NOT bake any one waiter's deadline into the flight — the
+        per-waiter `deadline` is applied here, around a shield, so expiry
+        cancels only this waiter's wait (`DeadlineExceededError`), never the
+        flight itself.
+        """
+        task = self._flights.get(key)
+        if task is None or task.done():
+            task = asyncio.create_task(factory())
+            # consume the exception even if every waiter detached before the
+            # flight failed — an unobserved-exception warning is not an
+            # acceptable failure mode for a cache tier
+            task.add_done_callback(self._reap(key))
+            self._flights[key] = task
+        elif self._on_coalesced is not None:
+            self._on_coalesced()
+        if deadline is None:
+            return await asyncio.shield(task)
+        return await deadline.wait_for(asyncio.shield(task), what)
+
+    def _reap(self, key: str):
+        def done(task: asyncio.Task) -> None:
+            if self._flights.get(key) is task:
+                del self._flights[key]
+            if not task.cancelled():
+                task.exception()  # mark retrieved; waiters re-raise their own
+
+        return done

@@ -32,6 +32,19 @@ path: rebuild the engine at the largest viable width over the surviving
 shards (lifecycle re-enters `warming` during the rebuild) or, when nothing
 is left to degrade to, a controlled exit with `FATAL_ENGINE_EXIT_CODE` so
 the supervisor warm-restarts through the persistent compile cache.
+
+Caching tier (ISSUE 5): `submit(..., key=<content hash>)` coalesces at
+admission — a second submit with the same key while the first is still in
+flight attaches a waiter future to the existing entry instead of enqueuing
+a duplicate image, so N byte-identical images in the batcher cost ONE
+engine slot and the result fans out to every waiter. Each waiter owns its
+OWN future: one waiter's expired deadline cancels only that waiter, never
+the shared entry, and a shared `PoisonImageError` reaches every waiter
+exactly once. On completion the optional `result_cache` is filled (success
+-> positive entry; poison -> negative entry; admission sheds and
+fatal/transient engine errors are NEVER cached). Unkeyed submits take the
+exact pre-cache path, so `SPOTTER_TPU_CACHE_MAX_MB=0` keeps serving
+bit-identical to a cache-less build.
 """
 
 import asyncio
@@ -89,6 +102,7 @@ class MicroBatcher:
         breaker: Optional[CircuitBreaker] = None,
         poison_max_splits: Optional[int] = None,
         fatal_exit_cb: Optional[Callable[[int], None]] = None,
+        result_cache=None,
     ) -> None:
         """`max_queue`/`batch_timeout_ms` default from the env knobs
         (`SPOTTER_TPU_QUEUE_DEPTH`, `SPOTTER_TPU_BATCH_TIMEOUT_MS`);
@@ -100,7 +114,10 @@ class MicroBatcher:
         `FATAL_ENGINE_EXIT_CODE` when a fatal device error cannot be
         survived by a degraded rebuild — the serving runtime wires
         `os._exit` here so the supervisor can warm-restart; `None` (library
-        use, tests) just leaves the breaker to shed."""
+        use, tests) just leaves the breaker to shed. `result_cache`
+        (ISSUE 5, a `caching.ResultCache` or None) is filled from keyed
+        submits on completion; keyed coalescing itself works with or
+        without it."""
         self.engine = engine
         self.max_batch = max_batch or engine.batch_buckets[-1]
         # Aggregate bucket sizing (ISSUE 3): under dp-sharded serving the
@@ -126,6 +143,10 @@ class MicroBatcher:
             )
         self.poison_max_splits = poison_max_splits
         self.fatal_exit_cb = fatal_exit_cb
+        self.result_cache = result_cache
+        # key -> (primary future, waiter futures): one queue entry per key,
+        # its result fanned to every waiter when the primary settles
+        self._keyed: dict[str, tuple[asyncio.Future, list[asyncio.Future]]] = {}
         self._lifecycle_tracker = None
         self._fatal_fired = False
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max(0, max_queue))
@@ -200,18 +221,40 @@ class MicroBatcher:
             "waited_ms": (time.monotonic() - t0) * 1000.0,
         }
 
-    async def submit(self, image: Image.Image, deadline: Optional[Deadline] = None) -> list[dict]:
+    async def submit(
+        self,
+        image: Image.Image,
+        deadline: Optional[Deadline] = None,
+        key: Optional[str] = None,
+    ) -> list[dict]:
         """One image in, its detections out (awaits the batched device call).
 
         Raises `DrainingError` / `CircuitOpenError` / `QueueFullError` at
         admission and `DeadlineExceededError` when `deadline` expires before
         the result lands; every caller gets an answer in bounded time.
+
+        `key` (the caching tier's content hash) coalesces: while a keyed
+        entry is in flight, a second submit with the same key attaches a
+        waiter future instead of enqueuing a duplicate image — no breaker /
+        queue-capacity check, because it adds ZERO engine work. Every keyed
+        caller (the first included) awaits a private waiter future, so a
+        deadline expiry cancels only that caller's wait, never the shared
+        entry. `key=None` (cache tier disabled) takes the exact pre-cache
+        path.
         """
         metrics = self.engine.metrics
         if self.draining:
             metrics.record_shed()
             raise DrainingError("MicroBatcher is draining or stopped")
         await self.start()
+        loop = asyncio.get_running_loop()
+        if key is not None:
+            entry = self._keyed.get(key)
+            if entry is not None and not entry[0].done():
+                metrics.record_coalesced_submit()
+                waiter: asyncio.Future = loop.create_future()
+                entry[1].append(waiter)
+                return await self._await_result(waiter, deadline, metrics)
         if not self.breaker.allow():
             metrics.record_shed()
             raise CircuitOpenError(
@@ -221,15 +264,39 @@ class MicroBatcher:
         if deadline is not None and deadline.expired():
             metrics.record_deadline_exceeded()
             raise deadline.exceeded("queue admission")
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut: asyncio.Future = loop.create_future()
+        if key is not None:
+            waiters: list[asyncio.Future] = []
+            self._keyed[key] = (fut, waiters)
+            # the callback captures ITS OWN waiters list: between the primary
+            # settling and this callback running, a fresh submit for the same
+            # key may have replaced the dict entry (it sees fut.done() and
+            # starts a new flight) — re-reading the dict there would strand
+            # these waiters unresolved forever
+            fut.add_done_callback(
+                lambda f, k=key, ws=waiters: self._settle_keyed(k, f, ws)
+            )
         try:
-            self._queue.put_nowait((image, fut, deadline))
+            # keyed entries carry no deadline in the queue tuple: the shared
+            # primary must outlive any single waiter's budget
+            self._queue.put_nowait((image, fut, deadline if key is None else None))
         except asyncio.QueueFull:
+            if key is not None and self._keyed.get(key, (None,))[0] is fut:
+                del self._keyed[key]
             metrics.record_shed()
             raise QueueFullError(
                 f"batch queue full ({self.max_queue} deep)",
                 retry_after_s=max(self.max_delay_s * 2.0, 0.05),
             ) from None
+        if key is None:
+            return await self._await_result(fut, deadline, metrics)
+        waiter = loop.create_future()
+        waiters.append(waiter)
+        return await self._await_result(waiter, deadline, metrics)
+
+    async def _await_result(
+        self, fut: asyncio.Future, deadline: Optional[Deadline], metrics
+    ) -> list[dict]:
         if deadline is None:
             return await fut
         try:
@@ -240,9 +307,47 @@ class MicroBatcher:
                 asyncio.shield(fut), max(deadline.remaining(), 0.0)
             )
         except asyncio.TimeoutError:
-            fut.cancel()
+            if fut.done() and not fut.cancelled():
+                # result landed on the expiry tick: consume the exception so
+                # nothing logs "never retrieved"; the deadline still rules
+                fut.exception()
+            else:
+                fut.cancel()
             metrics.record_deadline_exceeded()
             raise deadline.exceeded("batched detect") from None
+
+    def _settle_keyed(
+        self, key: str, primary: asyncio.Future, waiters: list[asyncio.Future]
+    ) -> None:
+        """Primary-future done callback: retire the keyed entry (only if it
+        is still ours — a successor flight may already own the key), fill
+        the result cache (success -> positive, poison -> negative; sheds and
+        engine faults are never cached), and fan the outcome to every
+        waiter. No waiter can attach after the primary is done (submit
+        checks `done()` before attaching), so `waiters` is complete here."""
+        entry = self._keyed.get(key)
+        if entry is not None and entry[0] is primary:
+            del self._keyed[key]
+        cache = self.result_cache
+        if primary.cancelled():  # defensive: nothing cancels keyed primaries
+            for w in waiters:
+                if not w.done():
+                    w.cancel()
+            return
+        exc = primary.exception()
+        if exc is None:
+            result = primary.result()
+            if cache is not None:
+                cache.put(key, result)
+            for w in waiters:
+                if not w.done():
+                    w.set_result([dict(d) for d in result])
+        else:
+            if cache is not None and isinstance(exc, PoisonImageError):
+                cache.put_negative(key, exc)
+            for w in waiters:
+                if not w.done():
+                    w.set_exception(exc)
 
     async def _pump(self) -> None:
         while True:

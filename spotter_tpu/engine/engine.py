@@ -160,11 +160,18 @@ class InferenceEngine:
         else:
             forward = apply_post
 
-        # One compiled program per batch bucket; jit caches by shape. Pixel
-        # buffers are donated: they are per-call staging arrays and freeing
-        # them keeps HBM headroom at large buckets.
+        # One compiled program per batch bucket; jit caches by shape. Only
+        # the uint8 staging buffer that device_rescale_normalize consumes is
+        # donated (it is per-call scratch and freeing it keeps HBM headroom
+        # at large buckets). The host-float path's pixel tensor is NOT: XLA
+        # cannot alias it to any of the tiny postprocess outputs, so donating
+        # it frees nothing and emits a "Some donated buffers were not
+        # usable: float32[...]" warning on every call (BENCH_r05 tail;
+        # ISSUE 5 satellite — tests/test_device_preprocess.py asserts the
+        # float path stays warning-free).
         self._forward = jax.jit(
-            forward, donate_argnums=(1,) if donate_pixels else ()
+            forward,
+            donate_argnums=(1,) if (donate_pixels and self.device_preprocess) else (),
         )
 
     def _place(self, mesh, device, batch_buckets: Sequence[int]) -> None:

@@ -58,6 +58,18 @@ class Metrics:
         self._fatal_engine_errors_total = 0
         self._engine_rebuilds_total = 0
         self._dp_degraded: dict | None = None
+        # Caching tier (ISSUE 5): result-cache hit/miss/negative-hit,
+        # single-flight coalescing at the two layers (URL-level fetch,
+        # content-hash-level engine submit), eviction count, and the cache's
+        # current size (entries + bytes, published by ResultCache on fill).
+        self._cache_hits_total = 0
+        self._cache_misses_total = 0
+        self._cache_negative_hits_total = 0
+        self._cache_evictions_total = 0
+        self._coalesced_fetches_total = 0
+        self._coalesced_submits_total = 0
+        self._cache_entries = 0
+        self._cache_bytes = 0
 
     def record_batch(
         self,
@@ -136,6 +148,41 @@ class Metrics:
             self._engine_rebuilds_total += 1
             self._dp_degraded = {"from": from_dp, "to": to_dp}
 
+    def record_cache_hit(self, n: int = 1) -> None:
+        """A /detect answered from the content-addressed result cache."""
+        with self._lock:
+            self._cache_hits_total += n
+
+    def record_cache_miss(self, n: int = 1) -> None:
+        with self._lock:
+            self._cache_misses_total += n
+
+    def record_cache_negative_hit(self, n: int = 1) -> None:
+        """A cached deterministic failure (4xx fetch / poison) short-circuited
+        the fetch/bisect machinery."""
+        with self._lock:
+            self._cache_negative_hits_total += n
+
+    def record_cache_eviction(self, n: int = 1) -> None:
+        with self._lock:
+            self._cache_evictions_total += n
+
+    def record_coalesced_fetch(self, n: int = 1) -> None:
+        """A request attached to an in-flight fetch for the same URL."""
+        with self._lock:
+            self._coalesced_fetches_total += n
+
+    def record_coalesced_submit(self, n: int = 1) -> None:
+        """A request attached to an in-flight engine call for the same
+        content hash instead of enqueuing its own image."""
+        with self._lock:
+            self._coalesced_submits_total += n
+
+    def set_cache_size(self, entries: int, nbytes: int) -> None:
+        with self._lock:
+            self._cache_entries = entries
+            self._cache_bytes = nbytes
+
     def set_decode_queue_depth(self, depth: int) -> None:
         with self._lock:
             self._decode_queue_depth = depth
@@ -197,6 +244,14 @@ class Metrics:
                 "fatal_engine_errors_total": self._fatal_engine_errors_total,
                 "engine_rebuilds_total": self._engine_rebuilds_total,
                 "dp_degraded": self._dp_degraded,
+                "cache_hits_total": self._cache_hits_total,
+                "cache_misses_total": self._cache_misses_total,
+                "cache_negative_hits_total": self._cache_negative_hits_total,
+                "cache_evictions_total": self._cache_evictions_total,
+                "coalesced_fetches_total": self._coalesced_fetches_total,
+                "coalesced_submits_total": self._coalesced_submits_total,
+                "cache_entries": self._cache_entries,
+                "cache_bytes": self._cache_bytes,
                 "shed_total": self._shed_total,
                 "deadline_exceeded_total": self._deadline_exceeded_total,
                 "batch_timeouts_total": self._batch_timeouts_total,

@@ -79,6 +79,7 @@ def build_detector_app(
     warmup: bool = False,
     mesh_spec: str | None = None,
     serve_dp: int | None = None,
+    cache_mb: float | None = None,
 ) -> AmenitiesDetector:
     model_name = model_name or os.environ.get("MODEL_NAME")
     if not model_name:
@@ -165,7 +166,17 @@ def build_detector_app(
     # dispatch-depth knob that already existed as a constructor arg.
     max_in_flight = int(os.environ.get("SPOTTER_TPU_MAX_IN_FLIGHT", "2"))
     batcher = MicroBatcher(engine, max_delay_ms=max_delay_ms, max_in_flight=max_in_flight)
-    return AmenitiesDetector(engine, batcher)
+    # Caching tier (ISSUE 5): opt-in result cache + single-flight coalescing
+    # in front of the engine. SPOTTER_TPU_CACHE_MAX_MB (or the explicit
+    # `cache_mb` arg, i.e. --cache-mb) arms it; unset/0 constructs none of
+    # the machinery — SPOTTER_TPU_CACHE_TTL_S / _CACHE_NEGATIVE_TTL_S bound
+    # entry lifetimes when it is on.
+    if cache_mb is None:
+        return AmenitiesDetector(engine, batcher)
+    from spotter_tpu.caching.result_cache import ResultCache
+
+    cache = ResultCache.from_env(metrics=engine.metrics, max_mb=cache_mb)
+    return AmenitiesDetector(engine, batcher, cache=cache)
 
 
 def ray_deployment():

@@ -34,6 +34,20 @@ content-length reject + streamed read cap), failures are a typed
 `FetchError`, deterministic 4xx statuses are not retried, and
 `SPOTTER_TPU_MAX_IMAGE_PIXELS` rejects decode bombs before convert()
 decodes them.
+
+Caching tier (ISSUE 5, opt-in via `SPOTTER_TPU_CACHE_MAX_MB`): listing-photo
+traffic is heavily duplicated and detection is deterministic per
+(model, image bytes, threshold), so the detector front-loads three exact
+short-circuits before any engine work: (1) URL-level single-flight — N
+concurrent requests for one URL share ONE fetch; (2) a negative cache —
+a recently-seen deterministic failure (non-retryable 4xx fetch, poison
+image) re-raises instantly instead of re-fetching/re-bisecting; (3) a
+content-addressed result cache — byte-identical images skip the engine
+entirely (the hit still decodes + draws, so the wire response is
+unchanged). Misses submit with the content hash as `key`, which the
+MicroBatcher uses for hash-level coalescing and cache fill. With the knob
+unset/0 none of this machinery is constructed and the path is bit-identical
+to a cache-less build.
 """
 
 import asyncio
@@ -56,6 +70,8 @@ try:
 except ImportError:  # minimal image — fallback loop below keeps the contract
     _HAVE_TENACITY = False
 
+from spotter_tpu.caching.result_cache import ResultCache, content_key, url_key
+from spotter_tpu.caching.singleflight import SingleFlight
 from spotter_tpu.engine.batcher import MicroBatcher
 from spotter_tpu.engine.engine import InferenceEngine
 from spotter_tpu.schemas import (
@@ -120,6 +136,12 @@ def _fetch_retryable(exc: BaseException) -> bool:
     return True
 
 
+# default for AmenitiesDetector(cache=...): build from the env knobs (None
+# when SPOTTER_TPU_CACHE_MAX_MB is unset/0). Pass None to force the tier off
+# or a ResultCache instance to use it regardless of the env.
+_CACHE_FROM_ENV = object()
+
+
 class AmenitiesDetector:
     """Framework-agnostic core; Ray Serve / aiohttp adapters wrap this."""
 
@@ -128,12 +150,29 @@ class AmenitiesDetector:
         engine: InferenceEngine,
         batcher: MicroBatcher | None = None,
         client: httpx.AsyncClient | None = None,
+        cache: ResultCache | None | object = _CACHE_FROM_ENV,
     ) -> None:
         self.engine = engine
         self.batcher = batcher or MicroBatcher(engine)
         self.fetch_timeout_s = _env_float(FETCH_TIMEOUT_ENV, DEFAULT_FETCH_TIMEOUT_S)
         self.fetch_max_bytes = _env_int(FETCH_MAX_BYTES_ENV, DEFAULT_FETCH_MAX_BYTES)
         self.client = client or httpx.AsyncClient(timeout=self.fetch_timeout_s)
+        # Caching tier (ISSUE 5): per-detector, never global — two detectors
+        # in one process (tests, replicas) must not share entries. None means
+        # the tier is fully off and every path below is bit-identical to a
+        # cache-less build.
+        if cache is _CACHE_FROM_ENV:
+            cache = ResultCache.from_env(metrics=engine.metrics)
+        self.cache: ResultCache | None = cache
+        self._fetch_flights = SingleFlight(
+            on_coalesced=engine.metrics.record_coalesced_fetch
+        )
+        if self.cache is not None and self.batcher.result_cache is None:
+            self.batcher.result_cache = self.cache
+        # content-key ingredients: the engine's identity half of the key
+        built = getattr(engine, "built", None)
+        self._cache_model = getattr(built, "model_name", None) or type(engine).__name__
+        self._cache_threshold = float(getattr(engine, "threshold", 0.5))
 
     def _check_fetch_size(self, url: str, nbytes: int) -> None:
         if self.fetch_max_bytes > 0 and nbytes > self.fetch_max_bytes:
@@ -212,15 +251,58 @@ class AmenitiesDetector:
                 await asyncio.sleep(wait)
         raise FetchError("failed to fetch image after retries")  # unreachable
 
+    async def _fetch_flight(self, url: str) -> bytes:
+        """The shared fetch flight body (cache tier on): one per URL at a
+        time, deadline-free — waiters apply their own budgets around it.
+        Deterministic failures land in the negative cache on the way out;
+        retryable ones (5xx, 429/408, timeouts, connect errors) never do."""
+        try:
+            return await self._fetch_with_retries(url)
+        except FetchError as exc:
+            if not exc.retryable:
+                self.cache.put_negative(url_key(url), exc)
+            raise
+        except httpx.HTTPStatusError as exc:
+            code = exc.response.status_code
+            if 400 <= code < 500 and code not in RETRYABLE_4XX:
+                self.cache.put_negative(url_key(url), exc)
+            raise
+
+    async def _fetch_for_request(self, url: str, deadline: Deadline | None) -> bytes:
+        if self.cache is None:  # tier off: the exact pre-cache path
+            fetch = self._fetch_with_retries(url)
+            if deadline is not None:
+                return await deadline.wait_for(fetch, "image fetch")
+            return await fetch
+        cached_failure = self.cache.get_negative(url_key(url))
+        if cached_failure is not None:
+            raise cached_failure
+        return await self._fetch_flights.run(
+            url,
+            lambda: self._fetch_flight(url),
+            deadline=deadline,
+            what="image fetch",
+        )
+
     async def _process_single_image(
         self, url: str, deadline: Deadline | None = None
     ) -> ImageResult:
         try:
-            fetch = self._fetch_with_retries(url)
-            if deadline is not None:
-                image_bytes = await deadline.wait_for(fetch, "image fetch")
-            else:
-                image_bytes = await fetch
+            image_bytes = await self._fetch_for_request(url, deadline)
+
+            cache_key: str | None = None
+            raw_detections: list[dict] | None = None
+            if self.cache is not None:
+                cache_key = content_key(
+                    self._cache_model, image_bytes, self._cache_threshold
+                )
+                # repeat poison: re-raise the cached verdict instead of
+                # letting the same bytes re-poison a batch through the
+                # bisect machinery
+                cached_failure = self.cache.get_negative(cache_key)
+                if cached_failure is not None:
+                    raise cached_failure
+                raw_detections = self.cache.get(cache_key)
 
             with Image.open(BytesIO(image_bytes)) as img_raw:
                 # decode-bomb guard: the header-declared pixel count is
@@ -228,7 +310,12 @@ class AmenitiesDetector:
                 check_image_pixels(img_raw)
                 image = img_raw.convert("RGB")
 
-            raw_detections = await self.batcher.submit(image, deadline=deadline)
+            if raw_detections is None:
+                # miss: the content hash rides into the batcher for
+                # hash-level coalescing + cache fill on completion
+                raw_detections = await self.batcher.submit(
+                    image, deadline=deadline, key=cache_key
+                )
 
             draw = ImageDraw.Draw(image)
             image_detections: list[DetectionResult] = []
@@ -349,6 +436,12 @@ class AmenitiesDetector:
                 {"from": initial_dp, "to": dp} if dp < initial_dp else None
             ),
             "engine_generation": getattr(self.engine, "generation", 0),
+            # caching tier (ISSUE 5): size state for fleet dashboards; the
+            # hit/miss/coalesce counters live in /metrics
+            "cache": (
+                self.cache.stats() if self.cache is not None
+                else {"enabled": False}
+            ),
         }
 
     async def drain(self) -> dict:

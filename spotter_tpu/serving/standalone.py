@@ -27,6 +27,12 @@ with the distinct preemption code. When `SPOTTER_TPU_ADMIN_TOKEN` is set,
 the state-changing admin endpoints (/drain, /profile) require it in the
 `X-Admin-Token` header — without the guard any client could drain a replica
 out of the fleet or trigger a trace capture.
+
+Caching tier (ISSUE 5): `--cache-mb` (or `SPOTTER_TPU_CACHE_MAX_MB`) arms
+the content-addressed result cache + single-flight coalescing tier in the
+detector/batcher; /healthz then reports the cache's size state and /metrics
+the hit/miss/coalesce/eviction counters. Unset/0 leaves serving
+bit-identical to a cache-less build.
 """
 
 import argparse
@@ -347,6 +353,13 @@ def main() -> None:
         help=f"host decode/resize pool size ({preprocess.DECODE_WORKERS_ENV})",
     )
     parser.add_argument(
+        "--cache-mb",
+        type=float,
+        default=None,
+        help="content-addressed result cache + request coalescing budget in "
+        "MB (SPOTTER_TPU_CACHE_MAX_MB; 0 disables the tier — the default)",
+    )
+    parser.add_argument(
         "--stub-engine",
         action="store_true",
         help=f"canned-detection stub engine ({stub_engine.STUB_ENGINE_ENV}=1); "
@@ -364,6 +377,10 @@ def main() -> None:
         os.environ["SPOTTER_TPU_DEVICE_PREPROCESS"] = "1"
     if args.decode_workers is not None:
         os.environ[preprocess.DECODE_WORKERS_ENV] = str(args.decode_workers)
+    if args.cache_mb is not None:
+        from spotter_tpu.caching.result_cache import CACHE_MAX_MB_ENV
+
+        os.environ[CACHE_MAX_MB_ENV] = str(args.cache_mb)
     web.run_app(
         make_app(
             model_name=args.model, warmup=not args.no_warmup, preemption=True

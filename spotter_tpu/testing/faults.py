@@ -39,6 +39,12 @@ Engine fault domain (ISSUE 4) adds three injections at the engine seams:
   health probe AND any dispatch placing work on it, with the DATA_LOSS /
   device-halted markers the fatal classifier keys on — the degraded-dp
   rebuild scenario, runnable on CPU virtual devices.
+
+The caching tier (ISSUE 5) adds one more seam: `cache_error=N` arms the
+next N `ResultCache` operations (get/put, positive or negative) to raise.
+The cache CONTAINS these — a broken cache must degrade to a miss or a
+skipped fill, never to a failed request — so the chaos case asserts
+requests keep succeeding (at miss-path latency) while the fault is armed.
 """
 
 import asyncio
@@ -68,6 +74,9 @@ class FaultPlan:
     poison_item: int = 0
     engine_oom: int = 0
     shard_dead: int = -1
+    # ISSUE 5 caching tier: armed ResultCache get/put failures (contained
+    # by the cache — requests must survive at miss-path cost)
+    cache_error: int = 0
     # set() to un-wedge hanging engine calls early (tests)
     release: threading.Event = field(default_factory=threading.Event)
     _lock: threading.Lock = field(default_factory=threading.Lock)
@@ -126,6 +135,7 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "poison_item",
             "engine_oom",
             "shard_dead",
+            "cache_error",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
         try:
@@ -196,6 +206,18 @@ def on_engine_dispatch(n_images: int, device_ids: list) -> None:
             f"injected device OOM: RESOURCE_EXHAUSTED while allocating batch "
             f"of {n_images}"
         )
+
+
+def on_cache(op: str, key: str) -> None:
+    """ResultCache hook, called on every get/put (positive and negative).
+    The cache wraps this in its own try/except: an injected raise exercises
+    the containment contract — degrade to miss/skipped fill, never fail the
+    request."""
+    plan = _active
+    if plan is None:
+        return
+    if plan._consume("cache_error"):
+        raise RuntimeError(f"injected cache failure ({op} {key!r})")
 
 
 def on_shard_probe(device_id: int) -> None:

@@ -216,3 +216,25 @@ def test_dab_detr_family_end_to_end():
     assert len(results) == 3
     for dets in results:
         assert all(set(d) == {"label", "score", "box"} for d in dets)
+
+
+def test_host_float_path_emits_no_donation_warning():
+    """ISSUE 5 satellite: only the uint8 staging buffer that
+    device_rescale_normalize consumes is donated. The host-float path's
+    float pixels can never alias the tiny postprocess outputs, so donating
+    them freed nothing and warned "Some donated buffers were not usable:
+    float32[...]" on every call (BENCH_r05 tail)."""
+    import warnings
+
+    built = build_detector("PekingU/rtdetr_v2_r101vd")
+    eng = InferenceEngine(
+        built, threshold=0.0, batch_buckets=(2,), device_preprocess=False
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        results = eng.detect(_imgs(2))
+    assert len(results) == 2
+    donation = [
+        w for w in caught if "donated buffers" in str(w.message).lower()
+    ]
+    assert donation == [], [str(w.message) for w in donation]
