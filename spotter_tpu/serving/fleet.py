@@ -1,0 +1,751 @@
+"""Fleet controller: spot-aware pools above supervisor + replica_pool + router.
+
+PRs 1-4 made ONE replica survivable (drain/exit-83 lifecycle, supervisor,
+engine fault domain); the fleet above it was still a flat list — one
+correlated preemption wave, the NORMAL failure mode of spot/preemptible TPU
+capacity (Spotlight, arXiv:2606.19004), took down SLO and bulk traffic alike
+and then amplified the damage with unbudgeted replays. This module is the
+tier that makes preemptible capacity first-class (DeepServe,
+arXiv:2501.14417, is the blueprint for the serverless half):
+
+- **Pools**: replicas are grouped into `on_demand` and `spot` pools, each a
+  `ReplicaPool` (health loop, ejection, replay) with its own retry-budget
+  slice, supervised members, and gauges. Requests are CLASSED — an
+  `X-Request-Class: slo|bulk` header or a `request_class` payload key (a
+  payload carrying `deadline_ms` defaults to slo) — and SLO traffic is
+  PINNED to on_demand while bulk drains to spot. Bulk never spills onto the
+  on_demand pool while spot capacity exists: protecting the SLO pool from a
+  bulk stampede is the point of the split. (Bulk falls back to on_demand
+  only when NO spot capacity is configured at all.)
+- **Preemption-storm survival**: a maintenance signal on a spot member
+  (exit 83, SPOTTER_TPU_PREEMPTION_FILE/_URL — the PR 2 machinery) drains
+  only that member; its in-flight and queued work replays onto survivors
+  under the pool's retry budget (SPOTTER_TPU_RETRY_BUDGET_PCT,
+  replica_pool.RetryBudget), so spot loss degrades bulk goodput but never
+  fails an SLO request. Members whose SUPERVISOR process dies (crash-loop
+  exit 84, host gone) are re-spawned with full-jittered exponential backoff
+  so a storm's restarts don't thunder-herd. The chaos harness can inject a
+  storm in-process: `SPOTTER_TPU_FAULTS=preempt_storm=N` preempts N ready
+  spot members through their handles (testing/faults.py).
+- **Scale-to-zero + restore**: a managed pool idle for
+  `SPOTTER_TPU_SCALE_TO_ZERO_S` drains and stops all members; the next
+  classed request triggers a demand restore through the persistent compile
+  cache (SPOTTER_TPU_COMPILE_CACHE_DIR), with `time_to_ready_s` measured
+  restore-trigger -> first member available and published in /metrics —
+  the <15 s (stubbed) gate `bench.py --preemption-storm` records.
+
+`make_fleet_app` is the HTTP surface (/detect with classification,
+/healthz, /livez, /metrics with `pool_size{pool,state}`,
+`preemptions_total`, `replays_total`, `retry_budget_exhausted_total`);
+`python -m spotter_tpu.serving.fleet` runs it over static endpoint lists,
+and `python -m spotter_tpu.serving.router --spot-endpoints ...` reuses the
+same app from the existing edge entrypoint. Managed (spawning) fleets are
+built in-process: `testing/cluster.py::fleet_spawner` supplies subprocess
+member handles for the bench and chaos tests.
+"""
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Protocol
+
+from aiohttp import web
+
+from spotter_tpu.serving.replica_pool import (
+    PoolExhaustedError,
+    ReplicaPool,
+    RetryBudget,
+)
+from spotter_tpu.testing import faults
+
+logger = logging.getLogger(__name__)
+
+# request classes
+SLO = "slo"
+BULK = "bulk"
+# canonical pool names (specs may add others; these two get the routing rules)
+ON_DEMAND = "on_demand"
+SPOT = "spot"
+
+REQUEST_CLASS_HEADER = "X-Request-Class"
+REQUEST_CLASS_KEY = "request_class"
+
+DEFAULT_CLASS_ENV = "SPOTTER_TPU_POOL_DEFAULT_CLASS"
+SCALE_TO_ZERO_ENV = "SPOTTER_TPU_SCALE_TO_ZERO_S"
+RESTORE_WAIT_ENV = "SPOTTER_TPU_POOL_RESTORE_WAIT_S"
+UNAVAILABLE_WAIT_ENV = "SPOTTER_TPU_POOL_UNAVAILABLE_WAIT_S"
+RESPAWN_BASE_ENV = "SPOTTER_TPU_POOL_RESPAWN_BASE_S"
+
+DEFAULT_RESTORE_WAIT_S = 20.0
+DEFAULT_UNAVAILABLE_WAIT_S = 3.0
+DEFAULT_RESPAWN_BASE_S = 0.5
+DEFAULT_RESPAWN_MAX_S = 30.0
+DEFAULT_TICK_S = 0.2
+
+# member states for the pool_size{pool,state} gauge
+READY = "ready"
+STARTING = "starting"
+DOWN = "down"
+DEAD = "dead"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def default_class_from_env() -> str:
+    """Unclassified traffic defaults to SLO: treating unknown requests as
+    latency-critical (pinned to on-demand) is the conservative choice —
+    bulk must OPT IN to ride preemptible capacity."""
+    raw = os.environ.get(DEFAULT_CLASS_ENV, "").strip().lower()
+    return raw if raw in (SLO, BULK) else SLO
+
+
+def classify_request(
+    headers=None, payload=None, default: Optional[str] = None
+) -> tuple[str, dict]:
+    """(request_class, forwardable_payload). Precedence: the
+    X-Request-Class header, then a `request_class` payload key (stripped
+    before forwarding — it is fleet routing metadata, not detector input),
+    then "slo" for payloads carrying a deadline tag, then the default."""
+    cls = ""
+    if headers is not None:
+        cls = str(headers.get(REQUEST_CLASS_HEADER, "")).strip().lower()
+    if isinstance(payload, dict):
+        if not cls:
+            cls = str(payload.get(REQUEST_CLASS_KEY, "")).strip().lower()
+        if REQUEST_CLASS_KEY in payload:
+            payload = {
+                k: v for k, v in payload.items() if k != REQUEST_CLASS_KEY
+            }
+        if not cls and "deadline_ms" in payload:
+            cls = SLO
+    if cls not in (SLO, BULK):
+        cls = default if default in (SLO, BULK) else default_class_from_env()
+    return cls, payload
+
+
+class MemberHandle(Protocol):
+    """What the controller needs from a managed member: the subprocess
+    implementation is testing/cluster.py::FleetMember (supervisor +
+    standalone stub server + per-member maintenance file); tests substitute
+    in-process fakes."""
+
+    url: str
+
+    def alive(self) -> bool: ...
+
+    def preempt(self) -> None: ...
+
+    def clear_preemption(self) -> None: ...
+
+    def shutdown(self, timeout_s: float = 10.0) -> str: ...
+
+
+@dataclass
+class PoolSpec:
+    """One pool's configuration. Exactly one population style per spec:
+    `endpoints` (static, unmanaged — no respawn/scale-to-zero),
+    `handles` (pre-spawned managed members), or `spawner` + `target_size`
+    (the controller spawns and maintains the population)."""
+
+    name: str
+    endpoints: list[str] = field(default_factory=list)
+    handles: list = field(default_factory=list)
+    spawner: Optional[Callable[[], MemberHandle]] = None
+    target_size: int = 0
+    # None -> SPOTTER_TPU_SCALE_TO_ZERO_S (managed pools only); <= 0 -> off
+    scale_to_zero_s: Optional[float] = None
+
+
+class _Member:
+    def __init__(self, url: str, handle: Optional[MemberHandle] = None) -> None:
+        self.url = url.rstrip("/")
+        self.handle = handle
+        self.was_available = False
+        self.ever_available = False
+        self.preempt_pending = False
+
+
+class FleetPool:
+    """A named pool: its ReplicaPool (routing/health/replay), its managed
+    members, and its lifecycle state (scale-to-zero, restore timing)."""
+
+    def __init__(self, spec: PoolSpec, pool: ReplicaPool,
+                 scale_to_zero_s: float) -> None:
+        self.spec = spec
+        self.pool = pool
+        self.scale_to_zero_s = scale_to_zero_s
+        self.members: list[_Member] = [_Member(u) for u in spec.endpoints]
+        self.last_used = time.monotonic()
+        self.scaled_to_zero = False
+        self.restoring = False
+        self.restore_started: Optional[float] = None
+        self._restore_counts = False  # True only for post-scale-to-zero restores
+        self.time_to_ready_s: Optional[float] = None
+        self.available = asyncio.Event()
+        # gauges/counters
+        self.preemptions_total = 0
+        self.respawns_total = 0
+        self.scale_to_zero_total = 0
+        self.restores_total = 0
+        # jittered-respawn state
+        self._respawn_backoff_s = 0.0
+        self._respawn_due: list[float] = []
+
+    @property
+    def managed(self) -> bool:
+        return self.spec.spawner is not None or any(
+            m.handle is not None for m in self.members
+        )
+
+    def has_capacity(self) -> bool:
+        """Can this pool EVER serve — members now, or a spawner that can
+        make some? (Routing falls back across pools only when this is
+        False: an empty-because-scaled-to-zero pool still has capacity.)"""
+        if self.members:
+            return True
+        return self.spec.spawner is not None and self.spec.target_size > 0
+
+    def member_for(self, url: str) -> Optional[_Member]:
+        url = url.rstrip("/")
+        for m in self.members:
+            if m.url == url:
+                return m
+        return None
+
+    def member_states(self, now: float) -> dict[str, int]:
+        sizes = {READY: 0, STARTING: 0, DOWN: 0, DEAD: 0}
+        for m in self.members:
+            if m.handle is not None and not m.handle.alive():
+                sizes[DEAD] += 1
+                continue
+            r = self.pool.replica_for(m.url)
+            if r is not None and r.available(now):
+                sizes[READY] += 1
+            elif m.ever_available:
+                sizes[DOWN] += 1
+            else:
+                sizes[STARTING] += 1
+        return sizes
+
+
+class FleetController:
+    """Routes classed traffic to pools and keeps the pools alive: observes
+    member health transitions, re-spawns dead members with jittered backoff,
+    applies injected preemption storms, scales idle pools to zero, and
+    restores them on demand. One background tick task; all state is
+    event-loop-confined."""
+
+    def __init__(
+        self,
+        specs: list[PoolSpec],
+        tick_s: float = DEFAULT_TICK_S,
+        retry_budget_pct: Optional[float] = None,
+        restore_wait_s: Optional[float] = None,
+        unavailable_wait_s: Optional[float] = None,
+        respawn_base_s: Optional[float] = None,
+        respawn_max_s: float = DEFAULT_RESPAWN_MAX_S,
+        rng: Optional[random.Random] = None,
+        pool_kwargs: Optional[dict] = None,
+    ) -> None:
+        if not specs:
+            raise ValueError("FleetController needs at least one PoolSpec")
+        self.tick_s = tick_s
+        self.restore_wait_s = (
+            restore_wait_s
+            if restore_wait_s is not None
+            else _env_float(RESTORE_WAIT_ENV, DEFAULT_RESTORE_WAIT_S)
+        )
+        self.unavailable_wait_s = (
+            unavailable_wait_s
+            if unavailable_wait_s is not None
+            else _env_float(UNAVAILABLE_WAIT_ENV, DEFAULT_UNAVAILABLE_WAIT_S)
+        )
+        self.respawn_base_s = (
+            respawn_base_s
+            if respawn_base_s is not None
+            else _env_float(RESPAWN_BASE_ENV, DEFAULT_RESPAWN_BASE_S)
+        )
+        self.respawn_max_s = respawn_max_s
+        self._rng = rng if rng is not None else random.Random()
+        self.default_class = default_class_from_env()
+        env_stz = _env_float(SCALE_TO_ZERO_ENV, 0.0)
+        self.pools: dict[str, FleetPool] = {}
+        for spec in specs:
+            if spec.name in self.pools:
+                raise ValueError(f"duplicate pool {spec.name!r}")
+            # each pool gets its OWN budget slice: a bulk-tier storm must not
+            # starve SLO-tier failover of replay tokens
+            rp = ReplicaPool(
+                list(spec.endpoints),
+                allow_empty=True,
+                retry_budget=RetryBudget(pct=retry_budget_pct),
+                **(pool_kwargs or {}),
+            )
+            stz = spec.scale_to_zero_s
+            if stz is None:
+                stz = env_stz if (spec.spawner is not None) else 0.0
+            self.pools[spec.name] = FleetPool(spec, rp, stz)
+        self._task: Optional[asyncio.Task] = None
+        self.storms_total = 0
+        self.class_requests = {SLO: 0, BULK: 0}
+        self.class_failures = {SLO: 0, BULK: 0}
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        for fp in self.pools.values():
+            for h in fp.spec.handles:
+                self._adopt(fp, h)
+            if fp.spec.spawner is not None:
+                while len(fp.members) < fp.spec.target_size:
+                    self._spawn(fp)
+            if fp.members and fp.pool.has_available() is False:
+                # initial bring-up: measure time-to-first-available
+                fp.restoring = True
+                fp.restore_started = time.monotonic()
+                fp._restore_counts = False
+            await fp.pool.start()
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self, shutdown_members: bool = True) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        for fp in self.pools.values():
+            await fp.pool.stop()
+        if shutdown_members:
+            loop = asyncio.get_running_loop()
+            waits = [
+                loop.run_in_executor(None, m.handle.shutdown)
+                for fp in self.pools.values()
+                for m in fp.members
+                if m.handle is not None
+            ]
+            if waits:
+                await asyncio.gather(*waits, return_exceptions=True)
+
+    def _adopt(self, fp: FleetPool, handle: MemberHandle) -> None:
+        fp.pool.add_endpoint(handle.url, healthy=False)
+        fp.members.append(_Member(handle.url, handle))
+
+    def _spawn(self, fp: FleetPool) -> None:
+        handle = fp.spec.spawner()
+        self._adopt(fp, handle)
+        logger.info("pool %s: spawned member %s", fp.spec.name, handle.url)
+
+    # ---- routing ----
+
+    def pool_for_class(self, cls: str) -> FleetPool:
+        """SLO pins to on_demand; bulk drains to spot. The fallback pool is
+        used only when the preferred one has NO capacity configured at all
+        (a storm-suspended or scaled-to-zero pool still HAS capacity — bulk
+        rides out the storm on spot rather than stampeding the SLO pool)."""
+        preferred = ON_DEMAND if cls == SLO else SPOT
+        fallback = ON_DEMAND if cls == BULK else SPOT
+        fp = self.pools.get(preferred)
+        if fp is not None and fp.has_capacity():
+            return fp
+        alt = self.pools.get(fallback)
+        if alt is not None and alt.has_capacity():
+            return alt
+        pick = fp or alt
+        return pick if pick is not None else next(iter(self.pools.values()))
+
+    def _maybe_restore(self, fp: FleetPool) -> None:
+        """Demand restore: spawn the missing population NOW (no backoff —
+        this is deliberate demand, not a crash loop) and start the
+        time-to-ready clock."""
+        if fp.spec.spawner is None or fp.restoring:
+            return
+        missing = fp.spec.target_size - len(fp.members)
+        if missing <= 0:
+            return
+        fp.restoring = True
+        fp.restore_started = time.monotonic()
+        fp._restore_counts = fp.scaled_to_zero
+        fp._respawn_due.clear()
+        for _ in range(missing):
+            self._spawn(fp)
+
+    async def request(self, path: str, payload: dict, cls: Optional[str] = None):
+        """Route one classed request through its pool, waking a
+        scaled-to-zero pool on the way. Bulk requests tolerate a bounded
+        wait for a restoring/stormed pool; SLO requests fail fast (the
+        caller turns PoolExhaustedError subclasses into 503 + Retry-After)."""
+        if cls not in (SLO, BULK):
+            cls = self.default_class
+        self.class_requests[cls] += 1
+        fp = self.pool_for_class(cls)
+        fp.last_used = time.monotonic()
+        if not fp.pool.has_available():
+            self._maybe_restore(fp)
+            if fp.restoring or cls == BULK:
+                wait_s = (
+                    self.restore_wait_s if fp.restoring
+                    else self.unavailable_wait_s
+                )
+                deadline = time.monotonic() + wait_s
+                # re-check REAL availability each wakeup: the event may be
+                # stale-set for a beat around a scale-down/retire transition
+                while not fp.pool.has_available():
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break  # fall through: the pool raises its fast 503
+                    try:
+                        await asyncio.wait_for(
+                            fp.available.wait(), min(remaining, self.tick_s)
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+            fp.last_used = time.monotonic()
+        try:
+            return await fp.pool.request(path, payload)
+        except PoolExhaustedError:
+            self.class_failures[cls] += 1
+            raise
+
+    async def detect(self, payload: dict, cls: Optional[str] = None) -> dict:
+        resp = await self.request("/detect", payload, cls)
+        return resp.json()
+
+    # ---- supervision tick ----
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self._tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("fleet tick failed")
+            await asyncio.sleep(self.tick_s)
+
+    async def _tick(self) -> None:
+        now = time.monotonic()
+        self._apply_storm()
+        for fp in self.pools.values():
+            self._observe_members(fp, now)
+            self._respawn_due_members(fp, now)
+            await self._maybe_scale_to_zero(fp, now)
+            if fp.pool.has_available():
+                if fp.restoring:
+                    fp.restoring = False
+                    fp.time_to_ready_s = time.monotonic() - fp.restore_started
+                    if fp._restore_counts:
+                        fp.restores_total += 1
+                    fp.scaled_to_zero = False
+                    # the idle clock starts when capacity is READY: a
+                    # bring-up longer than scale_to_zero_s must not get the
+                    # fresh pool reclaimed on the very next tick
+                    fp.last_used = time.monotonic()
+                    logger.info(
+                        "pool %s: available after %.2f s",
+                        fp.spec.name, fp.time_to_ready_s,
+                    )
+                fp.available.set()
+            else:
+                fp.available.clear()
+
+    def _apply_storm(self) -> None:
+        """Injected preemption storm (SPOTTER_TPU_FAULTS=preempt_storm=N or
+        faults.inject in-process): preempt up to N currently-available spot
+        members through their handles — the chaos entry point for
+        `bench.py --preemption-storm`."""
+        spot = self.pools.get(SPOT)
+        now = time.monotonic()
+        candidates = []
+        for m in (spot.members if spot is not None else []):
+            if m.handle is None:
+                continue
+            r = spot.pool.replica_for(m.url)
+            if r is not None and r.available(now):
+                candidates.append(m)
+        if not candidates:
+            # leave an armed storm for a tick that HAS ready targets: a
+            # maintenance wave hits running capacity, not an empty pool
+            return
+        n = faults.take_preempt_storm()
+        if n <= 0:
+            return
+        targets = candidates[:n]
+        for m in targets:
+            try:
+                m.handle.preempt()
+                m.preempt_pending = True
+            except Exception:
+                logger.exception("storm: preempting %s failed", m.url)
+        if targets:
+            self.storms_total += 1
+            logger.warning(
+                "preemption storm injected: %d of %d spot members",
+                len(targets), len(spot.members),
+            )
+
+    def _observe_members(self, fp: FleetPool, now: float) -> None:
+        for m in list(fp.members):
+            if m.handle is not None and not m.handle.alive():
+                # the SUPERVISOR process died (crash-loop exit 84, host
+                # gone): retire the member and re-spawn on jittered backoff
+                self._retire(fp, m, now)
+                continue
+            r = fp.pool.replica_for(m.url)
+            avail = r is not None and r.available(now)
+            if avail:
+                m.ever_available = True
+            if m.was_available and not avail:
+                if fp.spec.name == SPOT:
+                    # a spot member dropping out of ready IS a preemption in
+                    # this capacity class (drain via maintenance signal or a
+                    # straight kill) — the gauge the storm bench watches
+                    fp.preemptions_total += 1
+                if m.preempt_pending and m.handle is not None:
+                    # the maintenance file did its job (the child saw it and
+                    # drained): clear it so the supervisor's respawned child
+                    # doesn't immediately re-preempt itself
+                    try:
+                        m.handle.clear_preemption()
+                    except Exception:
+                        logger.exception("clearing preemption on %s failed", m.url)
+                    m.preempt_pending = False
+            m.was_available = avail
+
+    def _retire(self, fp: FleetPool, m: _Member, now: float) -> None:
+        fp.pool.remove_endpoint(m.url)
+        fp.members.remove(m)
+        logger.warning("pool %s: member %s dead; retired", fp.spec.name, m.url)
+        if fp.spec.spawner is None or fp.scaled_to_zero:
+            return
+        # full-jitter exponential backoff on the replacement spawn: a storm
+        # that kills many members at once must not respawn them in lockstep
+        fp._respawn_backoff_s = min(
+            max(fp._respawn_backoff_s * 2.0, self.respawn_base_s),
+            self.respawn_max_s,
+        )
+        delay = self._rng.uniform(0.0, fp._respawn_backoff_s)
+        fp._respawn_due.append(now + delay)
+        fp._respawn_due.sort()
+
+    def _respawn_due_members(self, fp: FleetPool, now: float) -> None:
+        while (
+            fp._respawn_due
+            and fp._respawn_due[0] <= now
+            and len(fp.members) < fp.spec.target_size
+        ):
+            fp._respawn_due.pop(0)
+            self._spawn(fp)
+            fp.respawns_total += 1
+        if (
+            not fp._respawn_due
+            and fp.members
+            and len(fp.members) >= fp.spec.target_size
+            and fp.pool.has_available()
+        ):
+            fp._respawn_backoff_s = 0.0
+
+    async def _maybe_scale_to_zero(self, fp: FleetPool, now: float) -> None:
+        if (
+            fp.scale_to_zero_s <= 0
+            or fp.scaled_to_zero
+            or fp.restoring
+            or not fp.members
+            or fp.spec.spawner is None
+            or now - fp.last_used < fp.scale_to_zero_s
+        ):
+            return
+        members = list(fp.members)
+        logger.info(
+            "pool %s: idle %.1f s; scaling %d members to zero",
+            fp.spec.name, now - fp.last_used, len(members),
+        )
+        fp.scaled_to_zero = True
+        fp.scale_to_zero_total += 1
+        fp._respawn_due.clear()
+        for m in members:
+            fp.pool.remove_endpoint(m.url)
+            fp.members.remove(m)
+        # clear availability NOW: the member shutdowns awaited below take
+        # seconds, and a demand-restore request landing in that window must
+        # wait on the event, not sail through on its stale set state
+        fp.available.clear()
+        loop = asyncio.get_running_loop()
+        waits = [
+            loop.run_in_executor(None, m.handle.shutdown)
+            for m in members
+            if m.handle is not None
+        ]
+        if waits:
+            await asyncio.gather(*waits, return_exceptions=True)
+
+    # ---- observability ----
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        pools = {}
+        pool_size = {}
+        preemptions = replays = budget_exhausted = suspended = 0
+        time_to_ready = {}
+        for name, fp in self.pools.items():
+            sizes = fp.member_states(now)
+            psnap = fp.pool.snapshot()
+            preemptions += fp.preemptions_total
+            replays += psnap["pool_replays_total"]
+            budget_exhausted += psnap["pool_retry_budget_exhausted_total"]
+            suspended += psnap["pool_suspended_total"]
+            pool_size[name] = sizes
+            time_to_ready[name] = fp.time_to_ready_s
+            pools[name] = {
+                "size": len(fp.members),
+                "target_size": fp.spec.target_size,
+                "state": sizes,
+                "managed": fp.managed,
+                "scaled_to_zero": fp.scaled_to_zero,
+                "restoring": fp.restoring,
+                "scale_to_zero_s": fp.scale_to_zero_s,
+                "time_to_ready_s": fp.time_to_ready_s,
+                "preemptions_total": fp.preemptions_total,
+                "respawns_total": fp.respawns_total,
+                "scale_to_zero_total": fp.scale_to_zero_total,
+                "restores_total": fp.restores_total,
+                "pool": psnap,
+            }
+        return {
+            "pool_size": pool_size,
+            "pools": pools,
+            "preemptions_total": preemptions,
+            "replays_total": replays,
+            "retry_budget_exhausted_total": budget_exhausted,
+            "suspended_total": suspended,
+            "storms_total": self.storms_total,
+            "requests_total": dict(self.class_requests),
+            "failures_total": dict(self.class_failures),
+            "time_to_ready_s": time_to_ready,
+        }
+
+
+# ---- HTTP surface ----
+
+
+def retry_after_header(exc: PoolExhaustedError) -> dict[str, str]:
+    return {"Retry-After": f"{max(1, round(getattr(exc, 'retry_after_s', 1.0)))}"}
+
+
+def make_fleet_app(controller: FleetController) -> web.Application:
+    """The fleet edge: /detect classifies (header/payload) and routes
+    through the controller; /metrics serves the pool gauges the storm bench
+    parses. The controller's tick loop starts/stops with the app."""
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["fleet"] = controller
+
+    async def on_startup(app: web.Application) -> None:
+        await controller.start()
+
+    async def on_cleanup(app: web.Application) -> None:
+        await controller.stop()
+
+    async def detect(request: web.Request) -> web.Response:
+        try:
+            payload = await request.json()
+        except json.JSONDecodeError:
+            return web.Response(status=400, text="Invalid JSON body")
+        cls, payload = classify_request(
+            request.headers, payload, default=controller.default_class
+        )
+        try:
+            resp = await controller.request("/detect", payload, cls)
+        except PoolExhaustedError as exc:
+            return web.json_response(
+                {"error": str(exc), "status": 503, "request_class": cls},
+                status=503,
+                headers=retry_after_header(exc),
+            )
+        return web.Response(
+            status=resp.status_code,
+            body=resp.content,
+            content_type="application/json",
+        )
+
+    async def healthz(request: web.Request) -> web.Response:
+        available = {
+            name: fp.pool.has_available()
+            for name, fp in controller.pools.items()
+        }
+        return web.json_response(
+            {"pools_available": available},
+            status=200 if any(available.values()) else 503,
+        )
+
+    async def livez(request: web.Request) -> web.Response:
+        return web.json_response({"status": "alive"})
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.json_response(controller.snapshot())
+
+    app.router.add_post("/detect", detect)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/livez", livez)
+    app.router.add_get("/metrics", metrics)
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def static_fleet(
+    on_demand: list[str], spot: list[str], **controller_kwargs
+) -> FleetController:
+    """Fleet over fixed endpoint lists (no spawning — the
+    router-as-data-plane deployment where members are k8s pods someone else
+    manages)."""
+    specs = []
+    if on_demand:
+        specs.append(PoolSpec(ON_DEMAND, endpoints=on_demand))
+    if spot:
+        specs.append(PoolSpec(SPOT, endpoints=spot))
+    return FleetController(specs, **controller_kwargs)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="spotter-tpu spot-aware fleet edge"
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument(
+        "--on-demand",
+        default=os.environ.get("SPOTTER_TPU_REPLICAS", ""),
+        help="comma-separated on-demand replica base URLs "
+        "(default SPOTTER_TPU_REPLICAS)",
+    )
+    parser.add_argument(
+        "--spot",
+        default=os.environ.get("SPOTTER_TPU_SPOT_REPLICAS", ""),
+        help="comma-separated spot replica base URLs "
+        "(default SPOTTER_TPU_SPOT_REPLICAS)",
+    )
+    args = parser.parse_args()
+    on_demand = [e.strip() for e in args.on_demand.split(",") if e.strip()]
+    spot = [e.strip() for e in args.spot.split(",") if e.strip()]
+    if not on_demand and not spot:
+        raise SystemExit("no endpoints: pass --on-demand and/or --spot")
+    logging.basicConfig(level=logging.INFO)
+    controller = static_fleet(on_demand, spot)
+    web.run_app(make_fleet_app(controller), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

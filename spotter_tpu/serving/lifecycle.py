@@ -40,6 +40,12 @@ PREEMPTION_FILE_ENV = "SPOTTER_TPU_PREEMPTION_FILE"
 PREEMPTION_URL_ENV = "SPOTTER_TPU_PREEMPTION_URL"
 PREEMPTION_POLL_ENV = "SPOTTER_TPU_PREEMPTION_POLL_S"
 RESTARTS_ENV = "SPOTTER_TPU_RESTARTS"
+# Which fleet pool this replica belongs to ("on_demand" / "spot"), set by
+# whatever spawned it (testing/cluster.py fleet members, a k8s nodeSelector
+# wrapper). Purely a label: it surfaces in /startupz + /healthz so an
+# operator — and the fleet controller's logs — can tell capacity classes
+# apart without consulting the spawner.
+POOL_ENV = "SPOTTER_TPU_POOL"
 
 DEFAULT_PREEMPTION_POLL_S = 5.0
 
@@ -86,6 +92,11 @@ def maybe_enable_compile_cache() -> Optional[str]:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     logger.info("persistent compile cache enabled at %s (warm restart)", cache_dir)
     return cache_dir
+
+
+def pool_from_env() -> Optional[str]:
+    """The fleet pool label this replica was spawned into, or None."""
+    return os.environ.get(POOL_ENV, "").strip() or None
 
 
 def restarts_from_env() -> int:
@@ -150,6 +161,7 @@ class StartupTracker:
             "state_age_s": time.monotonic() - self._since,
             "time_to_ready_s": self.time_to_ready_s,
             "error": self.error,
+            "pool": pool_from_env(),
         }
 
 

@@ -17,22 +17,42 @@ in one file:
   (connection reset — the signature of a killed replica), times out, or
   answers 5xx/429 is replayed against the next replica. Detection is
   idempotent, so replay is safe; the client sees one answer, not the
-  preemption.
+  preemption. Replays spend from a `RetryBudget` (ISSUE 6): a correlated
+  failure — a preemption storm taking half the fleet — must not amplify
+  offered load with unbudgeted retries, so replays in a sliding window are
+  capped at `SPOTTER_TPU_RETRY_BUDGET_PCT` of the recent request count
+  (with a small floor so single-replica deaths still fail over cleanly);
+  an exhausted budget fails the request FAST with a 503-shaped error
+  instead of piling more attempts onto survivors.
+- **Fast-fail when suspended** (ISSUE 6 bugfix): when every replica is
+  ejected or health-marked down — or the pool is empty because its tier
+  scaled to zero — `request()` raises `PoolSuspendedError` immediately
+  (with a Retry-After hint derived from the soonest un-ejection) instead of
+  burning the client's whole deadline on a candidate set that cannot serve.
 - **Hedging** (optional): after `hedge_after_s` with no answer, a duplicate
   fires at a second replica and the first response wins — the tail-latency
-  insurance for a replica that is technically alive but drowning.
+  insurance for a replica that is technically alive but drowning. Hedges
+  are bounded by their own counters and do NOT spend retry budget: they are
+  latency insurance against a live replica, not recovery from a dead one.
+
+Membership is dynamic (`add_endpoint` / `remove_endpoint`): the fleet
+controller (serving/fleet.py) grows and shrinks pools as spot capacity
+churns and idle tiers scale to zero.
 
 `bench.py --failover` drives this pool; `python -m spotter_tpu.serving.router`
 runs it as a tiny edge router. Counters surface in `snapshot()` (and the
-router's /metrics): ejections, replays, hedges, client-visible failures.
+router's /metrics): ejections, replays, hedges, budget exhaustions,
+client-visible failures.
 """
 
 import asyncio
 import itertools
 import logging
+import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 import httpx
 
@@ -44,6 +64,15 @@ DEFAULT_BACKOFF_MAX_S = 30.0
 DEFAULT_HEALTH_INTERVAL_S = 0.5
 DEFAULT_REQUEST_TIMEOUT_S = 30.0
 
+RETRY_BUDGET_PCT_ENV = "SPOTTER_TPU_RETRY_BUDGET_PCT"
+RETRY_BUDGET_MIN_ENV = "SPOTTER_TPU_RETRY_BUDGET_MIN"
+DEFAULT_RETRY_BUDGET_PCT = 10.0
+# Floor: a single killed replica can strand up to a client-concurrency's
+# worth of in-flight requests at once; those replays must never be the ones
+# the budget refuses, or plain one-replica failover (ISSUE 2) breaks.
+DEFAULT_RETRY_BUDGET_MIN = 10
+DEFAULT_RETRY_BUDGET_WINDOW_S = 30.0
+
 # statuses that mean "this replica can't serve it right now, another might":
 # 429 queue-full, 503 draining/breaker, 500 engine fault
 REPLAYABLE_STATUSES = frozenset({429, 500, 502, 503})
@@ -51,6 +80,93 @@ REPLAYABLE_STATUSES = frozenset({429, 500, 502, 503})
 
 class PoolExhaustedError(RuntimeError):
     """Every replica failed or was ejected for one request."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class PoolSuspendedError(PoolExhaustedError):
+    """No replica is even worth trying right now (all ejected/down, or the
+    pool is empty): fail fast with a Retry-After instead of waiting out the
+    request deadline against a candidate set that cannot serve."""
+
+
+class RetryBudgetExhaustedError(PoolExhaustedError):
+    """A replay was needed but the budget refuses to amplify load further."""
+
+
+class RetryBudget:
+    """Sliding-window retry budget (Envoy-style, rate-based): replays in the
+    last `window_s` seconds are capped at max(`min_retries`,
+    `pct`% of requests seen in the same window). Shared budgets are fine —
+    the fleet controller gives each pool its own slice so a bulk-tier storm
+    cannot starve SLO-tier failover.
+    """
+
+    def __init__(
+        self,
+        pct: Optional[float] = None,
+        min_retries: Optional[int] = None,
+        window_s: float = DEFAULT_RETRY_BUDGET_WINDOW_S,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if pct is None:
+            raw = os.environ.get(RETRY_BUDGET_PCT_ENV, "").strip()
+            pct = float(raw) if raw else DEFAULT_RETRY_BUDGET_PCT
+        if min_retries is None:
+            raw = os.environ.get(RETRY_BUDGET_MIN_ENV, "").strip()
+            min_retries = int(raw) if raw else DEFAULT_RETRY_BUDGET_MIN
+        self.pct = max(float(pct), 0.0)
+        self.min_retries = max(int(min_retries), 0)
+        self.window_s = window_s
+        self._clock = clock
+        self._requests: deque[float] = deque()
+        self._retries: deque[float] = deque()
+        self.exhausted_total = 0
+
+    def _trim(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._requests and self._requests[0] < horizon:
+            self._requests.popleft()
+        while self._retries and self._retries[0] < horizon:
+            self._retries.popleft()
+
+    def record_request(self) -> None:
+        now = self._clock()
+        self._trim(now)
+        self._requests.append(now)
+
+    def allowed(self) -> float:
+        """Replays currently permitted in the window."""
+        self._trim(self._clock())
+        return max(
+            float(self.min_retries), self.pct / 100.0 * len(self._requests)
+        )
+
+    def try_spend(self) -> bool:
+        """Reserve one replay; False (and a bumped exhausted counter) when
+        the window is already at its cap."""
+        now = self._clock()
+        self._trim(now)
+        if len(self._retries) + 1 > self.allowed():
+            self.exhausted_total += 1
+            return False
+        self._retries.append(now)
+        return True
+
+    def snapshot(self) -> dict:
+        now = self._clock()
+        self._trim(now)
+        return {
+            "pct": self.pct,
+            "min_retries": self.min_retries,
+            "window_s": self.window_s,
+            "window_requests": len(self._requests),
+            "window_retries": len(self._retries),
+            "allowed": self.allowed(),
+            "exhausted_total": self.exhausted_total,
+        }
 
 
 @dataclass
@@ -84,10 +200,13 @@ class ReplicaPool:
         hedge_after_s: Optional[float] = None,
         max_rounds: int = 2,
         round_pause_s: float = 0.25,
+        retry_budget: Optional[RetryBudget] = None,
+        allow_empty: bool = False,
     ) -> None:
-        if not endpoints:
+        if not endpoints and not allow_empty:
             raise ValueError("ReplicaPool needs at least one endpoint")
         self.replicas = [Replica(url=u.rstrip("/")) for u in endpoints]
+        self.retry_budget = retry_budget or RetryBudget()
         self.client = client or httpx.AsyncClient(
             timeout=httpx.Timeout(request_timeout_s, connect=2.0)
         )
@@ -108,6 +227,39 @@ class ReplicaPool:
         self.hedge_wins_total = 0
         self.ejections_total = 0
         self.failures_total = 0  # client-visible (pool exhausted)
+        self.suspended_total = 0  # fast-failed: nothing worth trying
+
+    # ---- membership (fleet controller: spot churn, scale-to-zero) ----
+
+    def add_endpoint(self, url: str, healthy: bool = False) -> Replica:
+        """Add a replica at runtime. New members default to `healthy=False`
+        ("starting"): the health loop promotes them on the first /healthz 200,
+        so live traffic never races a replica that is still binding/compiling."""
+        url = url.rstrip("/")
+        existing = self.replica_for(url)
+        if existing is not None:
+            return existing
+        r = Replica(url=url, healthy=healthy)
+        self.replicas.append(r)
+        return r
+
+    def remove_endpoint(self, url: str) -> Optional[Replica]:
+        url = url.rstrip("/")
+        r = self.replica_for(url)
+        if r is not None:
+            self.replicas.remove(r)
+        return r
+
+    def replica_for(self, url: str) -> Optional[Replica]:
+        url = url.rstrip("/")
+        for r in self.replicas:
+            if r.url == url:
+                return r
+        return None
+
+    def has_available(self) -> bool:
+        now = time.monotonic()
+        return any(r.available(now) for r in self.replicas)
 
     # ---- lifecycle ----
 
@@ -135,22 +287,23 @@ class ReplicaPool:
         except Exception as exc:
             ok = False
             r.last_error = f"health: {exc!r}"
-        if ok:
-            self._record_success(r)
-        else:
+        if not ok:
             r.healthy = False
+        elif not r.available(time.monotonic()):
+            # only an UNAVAILABLE replica is promoted by a probe success; on
+            # an available one the success is a no-op so probes cannot reset
+            # the consecutive-failure count live traffic is accumulating
+            self._record_success(r)
 
     async def _health_loop(self) -> None:
-        """Probe unavailable replicas so recovery (supervisor restart,
-        breaker close, drain replaced by a fresh pod) un-ejects them without
-        risking live traffic on a dead endpoint."""
+        """Probe every replica: an unavailable one so recovery (supervisor
+        restart, breaker close, drain replaced by a fresh pod) un-ejects it
+        without risking live traffic on a dead endpoint, and an available
+        one so a readiness flip (drain, maintenance notice — the preemption
+        signature the fleet controller watches) stops routing BEFORE the
+        replica starts refusing connections, even on an idle pool."""
         while True:
-            now = time.monotonic()
-            probes = [
-                self._probe(r)
-                for r in self.replicas
-                if not r.healthy or r.ejected_until > now
-            ]
+            probes = [self._probe(r) for r in self.replicas]
             if probes:
                 await asyncio.gather(*probes, return_exceptions=True)
             await asyncio.sleep(self.health_interval_s)
@@ -187,12 +340,35 @@ class ReplicaPool:
             if r.url not in exclude and r.available(now)
         ]
         if not candidates:
-            # last resort: an ejected-but-not-excluded replica beats failing
-            # the client outright (its ejection may be stale)
-            candidates = [r for r in self.replicas if r.url not in exclude]
-        if not candidates:
             return None
         return candidates[next(self._rr) % len(candidates)]
+
+    def _raise_if_suspended(self) -> None:
+        """Fail fast when nothing is worth trying: the pool is empty (scaled
+        to zero) or every replica is ejected/down. The Retry-After hint is
+        the soonest un-ejection (or one health-probe interval for replicas
+        merely marked down), so clients back off just long enough."""
+        now = time.monotonic()
+        if any(r.available(now) for r in self.replicas):
+            return
+        waits = [
+            r.ejected_until - now
+            for r in self.replicas
+            if r.ejected_until > now
+        ]
+        if waits:
+            retry_after = min(waits)
+        elif self.replicas:  # health-marked down: next probe may revive them
+            retry_after = self.health_interval_s
+        else:  # empty pool — membership has to change first
+            retry_after = 1.0
+        retry_after = min(max(retry_after, 0.5), self.backoff_max_s)
+        self.suspended_total += 1
+        self.failures_total += 1
+        raise PoolSuspendedError(
+            f"pool suspended: 0 of {len(self.replicas)} replicas available",
+            retry_after_s=retry_after,
+        )
 
     async def _attempt(self, r: Replica, path: str, payload: dict):
         r.requests += 1
@@ -205,10 +381,18 @@ class ReplicaPool:
         statuses; after a fully-failed round, pause briefly and run up to
         `max_rounds - 1` more (a preemption that takes the whole pool down
         for a beat — e.g. both replicas mid-drain — should cost the client
-        milliseconds, not an error). Raises PoolExhaustedError when every
-        round exhausted every replica."""
+        milliseconds, not an error). Every attempt after the first spends
+        from the retry budget; an exhausted budget raises
+        RetryBudgetExhaustedError rather than amplifying a correlated
+        failure. A pool with NO available replica fails fast with
+        PoolSuspendedError (503 + Retry-After at the router) instead of
+        waiting out the request deadline. Raises PoolExhaustedError when
+        every round exhausted every replica."""
         self.requests_total += 1
+        self.retry_budget.record_request()
+        self._raise_if_suspended()
         last_err = ""
+        first_attempt = True
         for round_idx in range(self.max_rounds):
             if round_idx:
                 await asyncio.sleep(self.round_pause_s)
@@ -216,7 +400,25 @@ class ReplicaPool:
             for attempt in range(len(self.replicas)):
                 r = self._pick(tried)
                 if r is None:
-                    break
+                    if not self.has_available():
+                        # everything got ejected mid-request (e.g. a storm
+                        # took the last survivor): stop burning the deadline
+                        self._raise_if_suspended()
+                    break  # all available replicas tried — next round
+                if not first_attempt:
+                    # about to replay: spend budget BEFORE the attempt, so a
+                    # correlated failure cannot amplify offered load
+                    if not self.retry_budget.try_spend():
+                        self.failures_total += 1
+                        raise RetryBudgetExhaustedError(
+                            f"retry budget exhausted "
+                            f"({self.retry_budget.snapshot()['window_retries']}"
+                            f" replays in {self.retry_budget.window_s:.0f} s "
+                            f"window; last: {last_err})",
+                            retry_after_s=1.0,
+                        )
+                    self.replays_total += 1
+                first_attempt = False
                 tried.add(r.url)
                 try:
                     if self.hedge_after_s is not None and attempt == 0:
@@ -226,7 +428,6 @@ class ReplicaPool:
                 except Exception as exc:  # connect/reset/timeout — kill signature
                     self._record_failure(r, repr(exc))
                     last_err = f"{r.url}: {exc!r}"
-                    self.replays_total += 1
                     continue
                 if resp.status_code in REPLAYABLE_STATUSES:
                     # the replica answered but can't serve (draining,
@@ -235,7 +436,6 @@ class ReplicaPool:
                     # replay elsewhere
                     self._record_failure(r, f"HTTP {resp.status_code}")
                     last_err = f"{r.url}: HTTP {resp.status_code}"
-                    self.replays_total += 1
                     continue
                 self._record_success(r)
                 return resp
@@ -297,6 +497,9 @@ class ReplicaPool:
             "pool_hedge_wins_total": self.hedge_wins_total,
             "pool_ejections_total": self.ejections_total,
             "pool_failures_total": self.failures_total,
+            "pool_suspended_total": self.suspended_total,
+            "pool_retry_budget_exhausted_total": self.retry_budget.exhausted_total,
+            "retry_budget": self.retry_budget.snapshot(),
             "replicas": [
                 {
                     "url": r.url,

@@ -7,16 +7,22 @@ replica fleet behind it churns (preemptions, restarts, drains). Routes:
 
 - POST /detect  — forwarded through the pool (health-aware selection,
   ejection, replay, optional hedging); a request fails only when EVERY
-  replica fails.
+  replica fails. A pool with nothing available (all ejected, or scaled to
+  zero) answers 503 IMMEDIATELY with a Retry-After derived from the
+  soonest un-ejection — it does not burn the client's deadline against an
+  empty candidate set (ISSUE 6 bugfix).
 - GET  /healthz — 200 while at least one replica is available (the router
   itself is an LB target).
 - GET  /livez   — router process liveness.
 - GET  /metrics — pool counters + per-replica state (ejections, replays,
-  hedges, failures).
+  hedges, retry-budget exhaustions, failures).
 
 Endpoints come from --endpoints or SPOTTER_TPU_REPLICAS (comma-separated
-base URLs). This is the edge half of the failover acceptance test: the
-chaos suite drives the same ReplicaPool in-process.
+base URLs). With --spot-endpoints (or SPOTTER_TPU_SPOT_REPLICAS) the router
+upgrades to the spot-aware fleet edge (serving/fleet.py): --endpoints
+become the on_demand pool, SLO traffic pins there, and bulk traffic drains
+to the spot pool. This is the edge half of the failover acceptance test:
+the chaos suite drives the same ReplicaPool in-process.
 """
 
 import argparse
@@ -27,11 +33,13 @@ import time
 
 from aiohttp import web
 
+from spotter_tpu.serving.fleet import retry_after_header
 from spotter_tpu.serving.replica_pool import PoolExhaustedError, ReplicaPool
 
 logger = logging.getLogger(__name__)
 
 REPLICAS_ENV = "SPOTTER_TPU_REPLICAS"
+SPOT_REPLICAS_ENV = "SPOTTER_TPU_SPOT_REPLICAS"
 HEDGE_ENV = "SPOTTER_TPU_HEDGE_MS"
 
 
@@ -56,7 +64,7 @@ def make_router_app(pool: ReplicaPool) -> web.Application:
             return web.json_response(
                 {"error": str(exc), "status": 503},
                 status=503,
-                headers={"Retry-After": "1"},
+                headers=retry_after_header(exc),
             )
         return web.Response(
             status=resp.status_code,
@@ -97,6 +105,13 @@ def main() -> None:
         help=f"comma-separated replica base URLs (default {REPLICAS_ENV})",
     )
     parser.add_argument(
+        "--spot-endpoints",
+        default=os.environ.get(SPOT_REPLICAS_ENV, ""),
+        help="comma-separated SPOT replica base URLs (default "
+        f"{SPOT_REPLICAS_ENV}); when given, the router runs the spot-aware "
+        "fleet edge: --endpoints serve SLO traffic, these serve bulk",
+    )
+    parser.add_argument(
         "--hedge-ms",
         type=float,
         default=float(os.environ.get(HEDGE_ENV, "0") or "0"),
@@ -104,9 +119,18 @@ def main() -> None:
     )
     args = parser.parse_args()
     endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
-    if not endpoints:
+    spot_endpoints = [
+        e.strip() for e in args.spot_endpoints.split(",") if e.strip()
+    ]
+    if not endpoints and not spot_endpoints:
         raise SystemExit(f"no replica endpoints: pass --endpoints or set {REPLICAS_ENV}")
     logging.basicConfig(level=logging.INFO)
+    if spot_endpoints:
+        from spotter_tpu.serving.fleet import make_fleet_app, static_fleet
+
+        controller = static_fleet(endpoints, spot_endpoints)
+        web.run_app(make_fleet_app(controller), host=args.host, port=args.port)
+        return
     pool = ReplicaPool(
         endpoints,
         hedge_after_s=args.hedge_ms / 1000.0 if args.hedge_ms > 0 else None,

@@ -236,6 +236,7 @@ def make_app(
             return _not_ready_response(tracker)
         health = det.health()
         health["startup"] = tracker.state
+        health["pool"] = lifecycle.pool_from_env()
         return web.json_response(health, status=200 if health["ready"] else 503)
 
     async def livez(request: web.Request) -> web.Response:

@@ -20,7 +20,13 @@ NOT hot-looping when the server crashes at import time. Policy:
   that stays dead must not hot-loop spawn→fatal→exit;
 - any other exit → restart after exponential backoff (`--backoff-base`,
   doubling to `--backoff-max`); a child that stayed up ≥ `--min-uptime`
-  resets the backoff;
+  resets the backoff. Backoff waits are FULL-JITTERED by default
+  (`SPOTTER_TPU_BACKOFF_JITTER=0` disables): the actual wait is drawn
+  uniformly from (0, cap] while the cap keeps its deterministic doubling.
+  A fleet of supervisors preempted by the same maintenance wave would
+  otherwise re-enter backoff in lockstep and thunder-herd the restarts
+  (ISSUE 6) — with full jitter, seeded differently per process, they
+  desynchronize;
 - crash-loop circuit: more than `--crash-loop` consecutive sub-min-uptime
   crashes → give up and exit non-zero (let the orchestrator above decide).
 
@@ -34,6 +40,7 @@ exits with the child's code — the pod-level preStop path stays intact.
 import argparse
 import logging
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -52,6 +59,15 @@ DEFAULT_CRASH_LOOP_LIMIT = 5
 DEFAULT_PREEMPT_FAST_LIMIT = 3
 CRASH_LOOP_EXIT_CODE = 84  # distinct from the child's codes and from 83
 
+BACKOFF_JITTER_ENV = "SPOTTER_TPU_BACKOFF_JITTER"
+
+
+def jitter_enabled_from_env() -> bool:
+    """Default ON: only an explicit 0/off/false disables it."""
+    return os.environ.get(BACKOFF_JITTER_ENV, "1").strip().lower() not in (
+        "0", "off", "false",
+    )
+
 
 class Supervisor:
     def __init__(
@@ -63,6 +79,8 @@ class Supervisor:
         crash_loop_limit: int = DEFAULT_CRASH_LOOP_LIMIT,
         preempt_fast_limit: int = DEFAULT_PREEMPT_FAST_LIMIT,
         pidfile: str | None = None,
+        jitter: bool | None = None,
+        rng: random.Random | None = None,
     ) -> None:
         if not cmd:
             raise ValueError("supervisor needs a command")
@@ -73,6 +91,11 @@ class Supervisor:
         self.crash_loop_limit = crash_loop_limit
         self.preempt_fast_limit = preempt_fast_limit
         self.pidfile = pidfile
+        self.jitter = jitter_enabled_from_env() if jitter is None else jitter
+        # per-process RNG (seedable in tests): two supervisors restarted by
+        # the same preemption wave draw different waits and desynchronize
+        self._rng = rng if rng is not None else random.Random()
+        self._backoff_s = 0.0  # deterministic doubling cap; waits jitter off it
         self.restarts_total = 0
         self.child: subprocess.Popen | None = None
         self._terminating = False
@@ -102,11 +125,26 @@ class Supervisor:
         if self.child is not None and self.child.poll() is None:
             self.child.send_signal(signal.SIGTERM)
 
+    def _reset_backoff(self) -> None:
+        self._backoff_s = 0.0
+
+    def _bump_backoff(self) -> float:
+        """Advance the deterministic doubling cap, then draw the actual wait:
+        full jitter (uniform over (0, cap]) when enabled, else the cap
+        itself. The cap trajectory stays identical across supervisors (so
+        the crash-loop window is predictable); only the waits decorrelate."""
+        self._backoff_s = min(
+            max(self._backoff_s * 2.0, self.backoff_base_s), self.backoff_max_s
+        )
+        if not self.jitter:
+            return self._backoff_s
+        return self._rng.uniform(0.0, self._backoff_s)
+
     def run(self) -> int:
         """Supervise until the child exits cleanly, the crash-loop circuit
         trips, or SIGTERM. Returns the exit code to propagate."""
         signal.signal(signal.SIGTERM, self._forward_term)
-        backoff = 0.0
+        self._reset_backoff()
         consecutive_fast_crashes = 0
         consecutive_fast_preempts = 0
         consecutive_fast_fatals = 0
@@ -150,18 +188,16 @@ class Supervisor:
                         "child hit a fatal engine error (exit %d); immediate "
                         "warm restart via compile cache", code,
                     )
-                    backoff = 0.0
+                    self._reset_backoff()
                 else:
-                    backoff = min(
-                        max(backoff * 2.0, self.backoff_base_s), self.backoff_max_s
-                    )
+                    wait_s = self._bump_backoff()
                     logger.warning(
                         "child hit fatal engine errors (exit %d) %d times under "
                         "%.1f s uptime — device appears to stay dead; "
                         "restarting in %.2f s",
-                        code, consecutive_fast_fatals, self.min_uptime_s, backoff,
+                        code, consecutive_fast_fatals, self.min_uptime_s, wait_s,
                     )
-                    if self._term_event.wait(backoff):
+                    if self._term_event.wait(wait_s):
                         logger.info("terminated during backoff; exiting %d", code)
                         return code
             elif code == PREEMPTED_EXIT_CODE:
@@ -181,24 +217,22 @@ class Supervisor:
                     logger.warning(
                         "child preempted (exit %d); immediate warm restart", code
                     )
-                    backoff = 0.0
+                    self._reset_backoff()
                 else:
-                    backoff = min(
-                        max(backoff * 2.0, self.backoff_base_s), self.backoff_max_s
-                    )
+                    wait_s = self._bump_backoff()
                     logger.warning(
                         "child preempted (exit %d) %d times under %.1f s uptime "
                         "— preemption source persists; restarting in %.2f s",
-                        code, consecutive_fast_preempts, self.min_uptime_s, backoff,
+                        code, consecutive_fast_preempts, self.min_uptime_s, wait_s,
                     )
-                    if self._term_event.wait(backoff):
+                    if self._term_event.wait(wait_s):
                         logger.info("terminated during backoff; exiting %d", code)
                         return code
             else:
                 consecutive_fast_preempts = 0
                 consecutive_fast_fatals = 0
                 if uptime >= self.min_uptime_s:
-                    backoff = 0.0
+                    self._reset_backoff()
                     consecutive_fast_crashes = 0
                 else:
                     consecutive_fast_crashes += 1
@@ -209,14 +243,12 @@ class Supervisor:
                             consecutive_fast_crashes, self.min_uptime_s,
                         )
                         return CRASH_LOOP_EXIT_CODE
-                backoff = min(
-                    max(backoff * 2.0, self.backoff_base_s), self.backoff_max_s
-                )
+                wait_s = self._bump_backoff()
                 logger.warning(
                     "child crashed (exit %d, uptime %.1f s); restarting in %.2f s",
-                    code, uptime, backoff,
+                    code, uptime, wait_s,
                 )
-                if self._term_event.wait(backoff):
+                if self._term_event.wait(wait_s):
                     logger.info("terminated during backoff; exiting %d", code)
                     return code
             self.restarts_total += 1
@@ -234,6 +266,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--preempt-fast", type=int, default=DEFAULT_PREEMPT_FAST_LIMIT,
                         help="consecutive sub-min-uptime preemption exits that "
                         "restart immediately before normal backoff applies")
+    parser.add_argument("--backoff-jitter", choices=["on", "off"], default=None,
+                        help=f"full-jitter backoff waits (default from "
+                        f"{BACKOFF_JITTER_ENV}, on unless set to 0)")
     parser.add_argument("--pidfile", default=None,
                         help="rewritten with the current child pid on every spawn")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
@@ -253,6 +288,8 @@ def main(argv: list[str] | None = None) -> int:
         crash_loop_limit=args.crash_loop,
         preempt_fast_limit=args.preempt_fast,
         pidfile=args.pidfile,
+        jitter=None if args.backoff_jitter is None
+        else args.backoff_jitter == "on",
     )
     return sup.run()
 

@@ -71,6 +71,12 @@ class SupervisedReplica:
         self.port = port
         self.url = f"http://127.0.0.1:{port}"
         self.pidfile = pidfile
+        # file-backed output, NOT a pipe: nothing drains a pipe until
+        # shutdown(), so a long-lived member (health probes log every poll)
+        # would fill the 64 KB pipe buffer and block the server on a stdout
+        # write — a "healthy" replica that suddenly stops answering /healthz
+        self.log_path = pidfile + ".log"
+        self._log_file = open(self.log_path, "w")
         cmd = [
             sys.executable, "-m", "spotter_tpu.serving.supervisor",
             "--backoff-base", str(backoff_base_s),
@@ -85,7 +91,7 @@ class SupervisedReplica:
             cmd,
             env=_hermetic_env(env),
             cwd=REPO_ROOT,
-            stdout=subprocess.PIPE,
+            stdout=self._log_file,
             stderr=subprocess.STDOUT,
             text=True,
         )
@@ -112,11 +118,16 @@ class SupervisedReplica:
         if self.proc.poll() is None:
             self.proc.send_signal(signal.SIGTERM)
         try:
-            out, _ = self.proc.communicate(timeout=timeout_s)
+            self.proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
             self.proc.kill()
-            out, _ = self.proc.communicate()
-        return out or ""
+            self.proc.wait()
+        self._log_file.close()
+        try:
+            with open(self.log_path) as f:
+                return f.read()
+        except OSError:
+            return ""
 
 
 def wait_ready(url: str, timeout_s: float = 60.0, interval_s: float = 0.1) -> float:
@@ -136,6 +147,76 @@ def wait_ready(url: str, timeout_s: float = 60.0, interval_s: float = 0.1) -> fl
             last = repr(exc)
         time.sleep(interval_s)
     raise TimeoutError(f"{url} not ready after {timeout_s} s (last: {last})")
+
+
+class FleetMember(SupervisedReplica):
+    """A supervised stub replica with the fleet controller's handle surface
+    (ISSUE 6): a per-member maintenance file (the PR 2 preemption source,
+    polled fast) and a pool label. `preempt()` is the storm fault — the
+    member drains, exits 83, and its supervisor warm-restarts it;
+    `clear_preemption()` removes the source so the restarted child doesn't
+    immediately re-preempt itself (the controller calls it once it observes
+    the member go down)."""
+
+    def __init__(
+        self,
+        port: int,
+        pidfile: str,
+        preempt_file: str,
+        pool: str = "spot",
+        env: dict | None = None,
+        **kwargs,
+    ) -> None:
+        self.preempt_file = preempt_file
+        self.pool = pool
+        member_env = {
+            "SPOTTER_TPU_PREEMPTION_FILE": preempt_file,
+            "SPOTTER_TPU_PREEMPTION_POLL_S": "0.05",
+            "SPOTTER_TPU_POOL": pool,
+        }
+        if env:
+            member_env.update(env)
+        super().__init__(port, pidfile, env=member_env, **kwargs)
+
+    def alive(self) -> bool:
+        """The SUPERVISOR process (a dead child mid-restart still counts as
+        alive — the supervisor owns bringing it back)."""
+        return self.proc.poll() is None
+
+    def preempt(self) -> None:
+        tmp = f"{self.preempt_file}.tmp"
+        with open(tmp, "w") as f:
+            f.write("injected preemption storm")
+        os.replace(tmp, self.preempt_file)  # atomic: the watcher never sees partial
+
+    def clear_preemption(self) -> None:
+        try:
+            os.unlink(self.preempt_file)
+        except OSError:
+            pass
+
+
+def fleet_spawner(workdir: str, pool: str, env: dict | None = None,
+                  **replica_kwargs):
+    """Factory for `FleetController` PoolSpec.spawner: each call spawns one
+    FleetMember on a fresh ephemeral port with its own pidfile + maintenance
+    file under `workdir`. The member is returned immediately (HTTP binds
+    before bring-up); the controller's health loop promotes it when
+    /healthz goes 200."""
+
+    def spawn() -> FleetMember:
+        (port,) = pick_ports(1)
+        tag = f"{pool}-{port}"
+        return FleetMember(
+            port,
+            os.path.join(workdir, f"{tag}.pid"),
+            os.path.join(workdir, f"{tag}.preempt"),
+            pool=pool,
+            env=env,
+            **replica_kwargs,
+        )
+
+    return spawn
 
 
 def start_replicas(

@@ -45,6 +45,15 @@ next N `ResultCache` operations (get/put, positive or negative) to raise.
 The cache CONTAINS these — a broken cache must degrade to a miss or a
 skipped fill, never to a failed request — so the chaos case asserts
 requests keep succeeding (at miss-path latency) while the fault is armed.
+
+The fleet tier (ISSUE 6) adds the CORRELATED failure shape —
+`preempt_storm=N`: the fleet controller (serving/fleet.py) consumes the
+whole value on its next supervision tick via `take_preempt_storm()` and
+preempts N currently-ready spot members at once through their handles
+(maintenance file -> drain -> exit 83 -> supervisor restart). This is the
+normal failure mode of spot TPU capacity — a maintenance wave, not an
+independent crash — and the scenario `bench.py --preemption-storm` and the
+fleet chaos tests measure.
 """
 
 import asyncio
@@ -77,6 +86,10 @@ class FaultPlan:
     # ISSUE 5 caching tier: armed ResultCache get/put failures (contained
     # by the cache — requests must survive at miss-path cost)
     cache_error: int = 0
+    # ISSUE 6 fleet tier: preempt this many ready spot members at once on
+    # the controller's next tick (consumed whole, not one-by-one — a storm
+    # is one correlated event)
+    preempt_storm: int = 0
     # set() to un-wedge hanging engine calls early (tests)
     release: threading.Event = field(default_factory=threading.Event)
     _lock: threading.Lock = field(default_factory=threading.Lock)
@@ -136,6 +149,7 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "engine_oom",
             "shard_dead",
             "cache_error",
+            "preempt_storm",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
         try:
@@ -218,6 +232,19 @@ def on_cache(op: str, key: str) -> None:
         return
     if plan._consume("cache_error"):
         raise RuntimeError(f"injected cache failure ({op} {key!r})")
+
+
+def take_preempt_storm() -> int:
+    """Fleet-controller hook: consume the armed storm size in one go (0 when
+    no plan or no storm armed). One storm is one correlated event — the
+    controller preempts that many spot members on the same tick."""
+    plan = _active
+    if plan is None:
+        return 0
+    with plan._lock:
+        n = plan.preempt_storm
+        plan.preempt_storm = 0
+    return n
 
 
 def on_shard_probe(device_id: int) -> None:

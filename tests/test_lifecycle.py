@@ -393,8 +393,62 @@ def test_stub_engine_detects_and_records_metrics():
     assert snap["images_total"] == 2
 
 
+def test_pool_label_from_env(monkeypatch):
+    """SPOTTER_TPU_POOL is a pure label (set by the fleet spawner) surfaced
+    through /startupz + /healthz so capacity classes are tellable apart."""
+    from spotter_tpu.serving import lifecycle
+
+    monkeypatch.delenv("SPOTTER_TPU_POOL", raising=False)
+    assert lifecycle.pool_from_env() is None
+    tracker = lifecycle.StartupTracker()
+    assert tracker.snapshot()["pool"] is None
+    monkeypatch.setenv("SPOTTER_TPU_POOL", "spot")
+    assert lifecycle.pool_from_env() == "spot"
+    assert tracker.snapshot()["pool"] == "spot"
+
+
 # ---- supervisor policy (in-process; the cross-process path is in
 # tests/test_failover.py) ----
+
+
+def test_supervisor_backoff_jitter_desynchronizes():
+    """ISSUE 6 satellite: two supervisors preempted by the same maintenance
+    wave must NOT re-enter backoff in lockstep. With full jitter (default
+    on) their waits decorrelate while the deterministic doubling cap — the
+    thing the crash-loop window is calibrated against — stays identical."""
+    import random
+    import sys
+
+    from spotter_tpu.serving.supervisor import Supervisor
+
+    cmd = [sys.executable, "-c", "pass"]
+    a = Supervisor(cmd, rng=random.Random(1), jitter=True)
+    b = Supervisor(cmd, rng=random.Random(2), jitter=True)
+    seq_a = [a._bump_backoff() for _ in range(6)]
+    seq_b = [b._bump_backoff() for _ in range(6)]
+    assert seq_a != seq_b  # desynchronized waits
+    caps = [0.5, 1.0, 2.0, 4.0, 8.0, 16.0]
+    for wait_a, wait_b, cap in zip(seq_a, seq_b, caps):
+        assert 0.0 <= wait_a <= cap
+        assert 0.0 <= wait_b <= cap
+    assert a._backoff_s == b._backoff_s == 16.0  # identical cap trajectory
+    # jitter off: the exact exponential sequence, reproducible
+    c = Supervisor(cmd, jitter=False)
+    assert [c._bump_backoff() for _ in range(3)] == [0.5, 1.0, 2.0]
+    # env knob: explicit 0 disables, unset enables
+    import os
+
+    from spotter_tpu.serving.supervisor import jitter_enabled_from_env
+
+    old = os.environ.pop("SPOTTER_TPU_BACKOFF_JITTER", None)
+    try:
+        assert jitter_enabled_from_env()
+        os.environ["SPOTTER_TPU_BACKOFF_JITTER"] = "0"
+        assert not jitter_enabled_from_env()
+    finally:
+        os.environ.pop("SPOTTER_TPU_BACKOFF_JITTER", None)
+        if old is not None:
+            os.environ["SPOTTER_TPU_BACKOFF_JITTER"] = old
 
 
 def test_supervisor_crash_loop_circuit():
@@ -501,6 +555,7 @@ def test_supervisor_persistent_preemption_falls_back_to_backoff(tmp_path):
         min_uptime_s=5.0,  # every child exit here counts as "fast"
         crash_loop_limit=3,  # < the 5 preemption exits: must NOT trip
         preempt_fast_limit=2,
+        jitter=False,  # this test times the deterministic cap trajectory
     )
     started = time.monotonic()
     assert sup.run() == 0
@@ -580,6 +635,7 @@ def test_supervisor_persistent_fatal_engine_falls_back_to_backoff(tmp_path):
         min_uptime_s=5.0,
         crash_loop_limit=2,  # < the 4 fatal exits: must NOT trip
         preempt_fast_limit=2,
+        jitter=False,  # this test times the deterministic cap trajectory
     )
     started = time.monotonic()
     assert sup.run() == 0

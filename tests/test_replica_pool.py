@@ -1,16 +1,26 @@
 """ReplicaPool unit tests (ISSUE 2): health-aware selection, replay on
 transport errors and replayable statuses, outlier ejection with exponential
-backoff + health-loop recovery, hedging, and the counters snapshot. Replicas
-here are tiny in-process aiohttp servers with scriptable behavior — the
-subprocess/chaos version lives in tests/test_failover.py."""
+backoff + health-loop recovery, hedging, and the counters snapshot. ISSUE 6
+adds the retry budget (replays capped at a fraction of recent request rate),
+the suspended-pool fast 503 (a fully-ejected/empty pool must not burn the
+client's deadline), and dynamic membership. Replicas here are tiny
+in-process aiohttp servers with scriptable behavior — the subprocess/chaos
+version lives in tests/test_failover.py and tests/test_fleet.py."""
 
 import asyncio
+import time
 
 import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestServer
 
-from spotter_tpu.serving.replica_pool import PoolExhaustedError, ReplicaPool
+from spotter_tpu.serving.replica_pool import (
+    PoolExhaustedError,
+    PoolSuspendedError,
+    ReplicaPool,
+    RetryBudget,
+    RetryBudgetExhaustedError,
+)
 
 PAYLOAD = {"image_urls": ["http://example.com/room.jpg"]}
 
@@ -273,3 +283,146 @@ def test_router_503_when_pool_exhausted():
 def test_pool_requires_endpoints():
     with pytest.raises(ValueError):
         ReplicaPool([])
+
+
+# ---- ISSUE 6: suspended-pool fast 503, retry budget, dynamic membership ----
+
+
+def test_all_ejected_fails_fast_with_retry_after():
+    """Regression: a pool whose every replica is ejected used to wait out
+    connect attempts and round pauses against an empty candidate set; it
+    must raise PoolSuspendedError immediately with a Retry-After hint."""
+
+    async def run():
+        pool = ReplicaPool(
+            ["http://127.0.0.1:1", "http://127.0.0.1:2"],
+            health_interval_s=30.0,
+        )
+        now = time.monotonic()
+        for r in pool.replicas:
+            r.ejected_until = now + 30.0
+        t0 = time.perf_counter()
+        with pytest.raises(PoolSuspendedError) as ei:
+            await pool.request("/detect", PAYLOAD)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.2  # no connects, no round pauses
+        assert ei.value.retry_after_s > 0
+        snap = pool.snapshot()
+        assert snap["pool_suspended_total"] == 1
+        assert snap["pool_failures_total"] == 1
+        await pool.stop()
+
+    asyncio.run(run())
+
+
+def test_router_503_immediate_when_all_ejected():
+    from aiohttp.test_utils import TestClient
+
+    from spotter_tpu.serving.router import make_router_app
+
+    async def run():
+        pool = ReplicaPool(["http://127.0.0.1:1"], health_interval_s=30.0)
+        pool.replicas[0].ejected_until = time.monotonic() + 30.0
+        app = make_router_app(pool)
+        async with TestClient(TestServer(app)) as client:
+            t0 = time.perf_counter()
+            resp = await client.post("/detect", json=PAYLOAD)
+            elapsed = time.perf_counter() - t0
+            assert resp.status == 503
+            assert int(resp.headers["Retry-After"]) >= 1
+            assert elapsed < 0.5
+
+    asyncio.run(run())
+
+
+def test_empty_pool_and_dynamic_membership():
+    async def run():
+        pool = ReplicaPool([], allow_empty=True, health_interval_s=30.0)
+        with pytest.raises(PoolSuspendedError):
+            await pool.request("/detect", PAYLOAD)
+        replicas, urls = await _with_replicas(1)
+        pool.add_endpoint(urls[0], healthy=True)
+        assert (await pool.detect(PAYLOAD))["served_by"] == "r0"
+        # adding the same URL twice is idempotent
+        pool.add_endpoint(urls[0])
+        assert len(pool.replicas) == 1
+        pool.remove_endpoint(urls[0])
+        with pytest.raises(PoolSuspendedError):
+            await pool.request("/detect", PAYLOAD)
+        await pool.stop()
+        await replicas[0].stop()
+
+    asyncio.run(run())
+
+
+def test_retry_budget_exhaustion_fails_fast():
+    """With a zero budget the FIRST attempt is still free, but the replay a
+    failing replica would trigger is refused — the request fails fast
+    instead of amplifying a correlated failure."""
+
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        for r in replicas:
+            r.status = 500
+        pool = ReplicaPool(
+            urls,
+            health_interval_s=30.0,
+            retry_budget=RetryBudget(pct=0.0, min_retries=0),
+        )
+        with pytest.raises(RetryBudgetExhaustedError) as ei:
+            await pool.detect(PAYLOAD)
+        assert ei.value.retry_after_s > 0
+        assert pool.replays_total == 0  # the replay never launched
+        snap = pool.snapshot()
+        assert snap["pool_retry_budget_exhausted_total"] == 1
+        assert snap["pool_failures_total"] == 1
+        # only the first (free) attempt reached a replica
+        assert sum(r.detect_calls for r in replicas) == 1
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_retry_budget_scales_with_request_rate():
+    t = {"now": 0.0}
+    rb = RetryBudget(pct=10.0, min_retries=0, window_s=10.0,
+                     clock=lambda: t["now"])
+    for _ in range(100):
+        rb.record_request()
+    assert rb.allowed() == 10.0
+    assert sum(rb.try_spend() for _ in range(15)) == 10
+    assert rb.exhausted_total == 5
+    # the window rolls: old requests (and spent retries) expire together
+    t["now"] = 11.0
+    assert rb.allowed() == 0.0
+    assert not rb.try_spend()
+    # fresh traffic reopens the budget
+    for _ in range(50):
+        rb.record_request()
+    assert rb.try_spend()
+    snap = rb.snapshot()
+    assert snap["window_requests"] == 50 and snap["window_retries"] == 1
+
+
+def test_default_budget_floor_preserves_single_death_failover():
+    """The floor exists so plain one-replica failover (ISSUE 2 semantics)
+    still replays freely: a dead replica plus a healthy one must keep
+    serving every request with the DEFAULT budget."""
+
+    async def run():
+        replicas, urls = await _with_replicas(1)
+        pool = ReplicaPool(
+            ["http://127.0.0.1:1", urls[0]],
+            eject_threshold=2,
+            backoff_base_s=5.0,
+            health_interval_s=30.0,
+        )
+        for _ in range(8):
+            assert (await pool.detect(PAYLOAD))["served_by"] == "r0"
+        assert pool.retry_budget.exhausted_total == 0
+        await pool.stop()
+        await replicas[0].stop()
+
+    asyncio.run(run())
