@@ -82,14 +82,31 @@ def serving_slo_bench(
 
     asyncio.run(drive())
     stats = engine.metrics.snapshot()
+    # One stage vocabulary (obs.STAGES) across /metrics, traces, and this
+    # JSON (ISSUE 7 satellite): the old "staging_p50_ms" read the
+    # "preprocess" alias that /metrics stopped emitting when PR 3 split it
+    # into decode + h2d — the two reports disagreed on what staging meant.
+    from spotter_tpu import obs
+
+    stage_p50s = {
+        name: stats.get(f"stage_{name}_ms_p50") for name in obs.ENGINE_STAGES
+    }
+    decode_p50 = stage_p50s.get(obs.DECODE)
+    h2d_p50 = stage_p50s.get(obs.H2D)
     return {
         "raw_p50_ms": float(np.median(lats)) * 1e3,
         # dispatch -> data-on-host; through the tunnel this includes the
         # ~20 MB pixel upload the device waits on, so it is an upper bound
-        "device_window_p50_ms": stats.get("stage_device_ms_p50"),
-        # real host staging cost (PIL -> numpy -> device_put enqueue)
-        "staging_p50_ms": stats.get("stage_preprocess_ms_p50"),
-        "postprocess_p50_ms": stats.get("stage_postprocess_ms_p50"),
+        "device_window_p50_ms": stage_p50s.get(obs.DEVICE),
+        # real host staging cost (PIL -> numpy -> device_put enqueue) =
+        # decode + h2d in the unified vocabulary
+        "staging_p50_ms": (
+            decode_p50 + h2d_p50
+            if decode_p50 is not None and h2d_p50 is not None
+            else None
+        ),
+        "postprocess_p50_ms": stage_p50s.get(obs.POSTPROCESS),
+        "stages_ms_p50": stage_p50s,
         "mean_batch": stats.get("mean_batch_size"),
         "n": len(lats),
     }
@@ -833,6 +850,105 @@ def chaos_serve_bench(args) -> int:
     return 0
 
 
+def trace_overhead_bench(args) -> int:
+    """Tracing-cost proof (ISSUE 7 acceptance): drive the REAL MicroBatcher
+    + stub engine with the flight recorder ON (every request traced: trace
+    allocation, queue_wait span, engine stage-span fan-out, recorder
+    append) and OFF (ring 0: every obs helper is a None check), and report
+    the p50 delta. CPU ok, model-free — the quantity under test is the
+    observability machinery on the hot path, not the forward pass.
+
+    Gate: < 1% p50 regression with the recorder on. Prints ONE JSON line.
+    """
+    import asyncio
+    import os
+
+    from PIL import Image
+
+    from spotter_tpu import obs
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.testing.stub_engine import StubEngine
+
+    service_ms = args.trace_service_ms
+    n_requests = args.trace_requests
+    concurrency = args.trace_concurrency
+    img = Image.fromarray(np.zeros((32, 32, 3), np.uint8))
+
+    def run_pass(ring: int) -> list[float]:
+        os.environ[obs.TRACE_RING_ENV] = str(ring)
+        obs.reset_recorder()
+        recorder = obs.get_recorder()
+        assert recorder.enabled == (ring > 0)
+        engine = StubEngine(service_ms=service_ms)
+        batcher = MicroBatcher(engine, max_delay_ms=1.0)
+        lats: list[float] = []
+
+        async def drive():
+            sem = asyncio.Semaphore(concurrency)
+
+            async def one(i: int):
+                async with sem:
+                    t0 = time.perf_counter()
+                    trace = obs.begin_trace(
+                        request_id=f"bench-{ring}-{i}",
+                        enabled=recorder.enabled,
+                    )
+                    await batcher.submit(img)
+                    recorder.record(trace)
+                    obs.set_current_trace(None)
+                    lats.append(time.perf_counter() - t0)
+
+            await asyncio.gather(*(one(i) for i in range(n_requests)))
+            await batcher.stop()
+
+        asyncio.run(drive())
+        return lats
+
+    try:
+        # warm both paths once (bytecode/alloc caches), then measure in
+        # interleaved off/on rounds: pooling alternated halves cancels the
+        # slow machine drift an ordered off-then-on pair would alias
+        # straight into the delta
+        run_pass(0)
+        run_pass(256)
+        off: list[float] = []
+        on: list[float] = []
+        for _ in range(args.trace_rounds):
+            off += run_pass(0)
+            on += run_pass(256)
+    finally:
+        os.environ.pop(obs.TRACE_RING_ENV, None)
+        obs.reset_recorder()
+    p50_off = float(np.median(off)) * 1e3
+    p50_on = float(np.median(on)) * 1e3
+    delta_pct = (p50_on - p50_off) / p50_off * 100.0 if p50_off else 0.0
+    stats = obs.trace_stats()
+    print(
+        f"# trace-overhead: {len(on)} traced + {len(off)} untraced requests "
+        f"(stub service {service_ms:.0f} ms, concurrency {concurrency}): "
+        f"p50 off {p50_off:.3f} ms -> on {p50_on:.3f} ms "
+        f"({delta_pct:+.2f}%); spans created {stats['spans_created']}",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"trace-capture p50 overhead, recorder on vs off "
+            f"(stub service {service_ms:.0f} ms, {n_requests} req/pass, "
+            f"concurrency {concurrency}; gate < 1%)"
+        ),
+        "value": round(delta_pct, 3),
+        "unit": "percent",
+        "p50_off_ms": round(p50_off, 3),
+        "p50_on_ms": round(p50_on, 3),
+        "p99_off_ms": round(float(np.percentile(off, 99)) * 1e3, 3),
+        "p99_on_ms": round(float(np.percentile(on, 99)) * 1e3, 3),
+        "gate_pct": 1.0,
+        "pass": bool(delta_pct < 1.0),
+    }
+    print(json.dumps(result))
+    return 0 if delta_pct < 1.0 else 1
+
+
 def cache_bench(args) -> int:
     """Caching tier, measured not asserted (ISSUE 5): the REAL detector +
     MicroBatcher + result-cache/coalescing plumbing under a Zipf-distributed
@@ -1141,9 +1257,13 @@ def multichip_serve_bench(args) -> int:
     efficiency = speedup / dp
 
     def stages(snap):
+        from spotter_tpu import obs
+
+        # the one stage vocabulary (ISSUE 7 satellite): /metrics, trace
+        # spans, and this JSON all key off obs.STAGES
         return {
             name: snap.get(f"stage_{name}_ms_p50")
-            for name in ("decode", "h2d", "device", "postprocess")
+            for name in obs.ENGINE_STAGES
         }
 
     print(
@@ -1316,6 +1436,21 @@ def main() -> int:
     parser.add_argument("--cache-fetch-ms", type=float, default=2.0)
     parser.add_argument("--cache-budget-mb", type=float, default=64.0)
     parser.add_argument(
+        "--trace-overhead",
+        action="store_true",
+        help="run the tracing-cost bench instead (CPU ok, model-free): p50 "
+        "delta through the real MicroBatcher with the flight recorder on "
+        "vs off; exits non-zero when the delta breaks the < 1%% gate",
+    )
+    parser.add_argument("--trace-requests", type=int, default=400)
+    parser.add_argument("--trace-rounds", type=int, default=3,
+                        help="interleaved off/on measurement rounds")
+    parser.add_argument("--trace-concurrency", type=int, default=8)
+    # 25 ms per batch ~ the measured R101 batch-8 pace (BENCH_r05, same
+    # calibration as --cache-service-ms): the overhead ratio is only honest
+    # against the latency a real engine produces
+    parser.add_argument("--trace-service-ms", type=float, default=25.0)
+    parser.add_argument(
         "--multichip-serve",
         action="store_true",
         help="run the dp-sharded serving bench instead: aggregate img/s over "
@@ -1337,6 +1472,8 @@ def main() -> int:
 
     if args.overload:
         return overload_bench(args)
+    if args.trace_overhead:
+        return trace_overhead_bench(args)
     if args.failover:
         return failover_bench(args)
     if args.preemption_storm:

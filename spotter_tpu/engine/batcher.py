@@ -54,6 +54,7 @@ from typing import Callable, Optional
 
 from PIL import Image
 
+from spotter_tpu import obs
 from spotter_tpu.engine.engine import InferenceEngine
 from spotter_tpu.engine.errors import (
     DEFAULT_POISON_MAX_SPLITS,
@@ -149,6 +150,7 @@ class MicroBatcher:
         self._keyed: dict[str, tuple[asyncio.Future, list[asyncio.Future]]] = {}
         self._lifecycle_tracker = None
         self._fatal_fired = False
+        self._fatal_traces: list = []
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max(0, max_queue))
         self._pump_task: Optional[asyncio.Task] = None
         self._in_flight: set[asyncio.Task] = set()
@@ -195,7 +197,7 @@ class MicroBatcher:
             await asyncio.gather(*self._in_flight, return_exceptions=True)
         # … then fail anything still queued so no submit() caller waits forever
         while not self._queue.empty():
-            _, fut, _ = self._queue.get_nowait()
+            fut = self._queue.get_nowait()[1]
             if not fut.done():
                 fut.set_exception(DrainingError("MicroBatcher stopped"))
 
@@ -278,8 +280,17 @@ class MicroBatcher:
             )
         try:
             # keyed entries carry no deadline in the queue tuple: the shared
-            # primary must outlive any single waiter's budget
-            self._queue.put_nowait((image, fut, deadline if key is None else None))
+            # primary must outlive any single waiter's budget. The ambient
+            # request trace (ISSUE 7) rides along so the pump can attribute
+            # this item's queue wait and the engine its stage windows; with
+            # the flight recorder off it is None and costs nothing.
+            self._queue.put_nowait((
+                image,
+                fut,
+                deadline if key is None else None,
+                obs.current_trace(),
+                time.monotonic(),
+            ))
         except asyncio.QueueFull:
             if key is not None and self._keyed.get(key, (None,))[0] is fut:
                 del self._keyed[key]
@@ -374,9 +385,11 @@ class MicroBatcher:
                 # stop() cancelled us while we hold a drained batch that no
                 # in-flight task owns yet — fail its futures or their
                 # submit() callers would wait forever
-                for _, f, _ in batch:
-                    if not f.done():
-                        f.set_exception(DrainingError("MicroBatcher stopped"))
+                for item in batch:
+                    if not item[1].done():
+                        item[1].set_exception(
+                            DrainingError("MicroBatcher stopped")
+                        )
                 raise
             task = asyncio.create_task(self._run_batch(batch))
             self._in_flight.add(task)
@@ -423,6 +436,21 @@ class MicroBatcher:
             if not batch:
                 return
             images = [b[0] for b in batch]
+            # queue-wait attribution (ISSUE 7): each item's submit -> here.
+            # slow_stage=queue_wait:<ms> injects before the dispatch stamp
+            # so the injected latency lands inside the queue_wait span.
+            qw_delay = faults.stage_delay_s(obs.QUEUE_WAIT)
+            if qw_delay > 0.0:
+                await asyncio.sleep(qw_delay)
+            t_dispatch = time.monotonic()
+            traces = []
+            for item in batch:
+                if item[3] is not None:
+                    item[3].add_span(obs.QUEUE_WAIT, item[4], t_dispatch)
+                    traces.append(item[3])
+            # the engine worker thread inherits this via asyncio.to_thread's
+            # context copy and fans its stage windows out to these traces
+            obs.set_batch_traces(traces)
             try:
                 detect = asyncio.to_thread(
                     self._detect_outcomes, images, self.poison_max_splits
@@ -441,9 +469,9 @@ class MicroBatcher:
                     f"engine batch of {len(batch)} timed out after "
                     f"{self.batch_timeout_s:.1f} s (watchdog)"
                 )
-                for _, f, _ in batch:
-                    if not f.done():
-                        f.set_exception(exc)
+                for item in batch:
+                    if not item[1].done():
+                        item[1].set_exception(exc)
                 return
             except FatalEngineError as exc:
                 await self._handle_fatal(batch, exc)
@@ -452,9 +480,9 @@ class MicroBatcher:
                 # contain failure to this batch only
                 self.engine.metrics.record_error(len(batch))
                 self.breaker.record_failure()
-                for _, f, _ in batch:
-                    if not f.done():
-                        f.set_exception(exc)
+                for item in batch:
+                    if not item[1].done():
+                        item[1].set_exception(exc)
                 return
             self._settle_outcomes(batch, outcomes)
         finally:
@@ -475,7 +503,13 @@ class MicroBatcher:
                 poisons = sum(1 for o in failed if isinstance(o, PoisonImageError))
                 self.engine.metrics.record_poison_isolated(poisons)
                 self.engine.metrics.record_error(len(failed))
-        for (_, f, _), out in zip(batch, outcomes):
+        for item, out in zip(batch, outcomes):
+            f, trace = item[1], item[3]
+            if isinstance(out, BaseException) and trace is not None:
+                # pin the trace even when the future is already settled (a
+                # deadline-expired waiter): the flight recorder's error set
+                # is where a poison post-mortem starts
+                trace.set_error(type(out).__name__, str(out))
             if f.done():
                 continue
             if isinstance(out, BaseException):
@@ -499,9 +533,14 @@ class MicroBatcher:
         self.engine.metrics.record_fatal_engine_error()
         self.engine.metrics.record_error(len(batch))
         self.breaker.record_failure()
-        for _, f, _ in batch:
-            if not f.done():
-                f.set_exception(exc)
+        fatal_traces = []
+        for item in batch:
+            if item[3] is not None:
+                item[3].set_error("fatal", str(exc))
+                fatal_traces.append(item[3])
+            if not item[1].done():
+                item[1].set_exception(exc)
+        self._fatal_traces = fatal_traces
         gen = getattr(self.engine, "generation", None)
         if getattr(self.engine, "can_degrade", lambda: False)():
             if await self._rebuild_degraded(gen):
@@ -555,4 +594,12 @@ class MicroBatcher:
                 "fatal engine error with nothing left to degrade to; exiting "
                 "%d for supervisor warm restart: %s", FATAL_ENGINE_EXIT_CODE, exc,
             )
+            # flight-recorder post-mortem (ISSUE 7): the offending batch's
+            # traces never reach an HTTP handler on this path (os._exit is
+            # next), so record them here and dump the recorder to disk —
+            # the on-disk artifact is how "which request killed dp=1" gets
+            # answered after the warm restart
+            for trace in getattr(self, "_fatal_traces", []):
+                obs.get_recorder().record(trace)
+            obs.dump_for_exit(FATAL_ENGINE_EXIT_CODE)
             self.fatal_exit_cb(FATAL_ENGINE_EXIT_CODE)

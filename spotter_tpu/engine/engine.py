@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from PIL import Image
 
+from spotter_tpu import obs
 from spotter_tpu.engine.errors import (
     FatalEngineError,
     TransientEngineError,
@@ -410,6 +411,7 @@ class InferenceEngine:
         /metrics and bench.py can show where ingest time goes.
         """
         t0 = time.monotonic()
+        faults.sleep_stage(obs.DECODE)  # slow_stage=decode:<ms> injection
         n = len(images)
         bucket = self.bucket_for(n)
         spec = self.built.preprocess_spec
@@ -443,6 +445,7 @@ class InferenceEngine:
                 sizes = np.concatenate([sizes, np.ones((pad, 2), sizes.dtype)])
             host_arrays = (pixels, masks, sizes)
         t_decode = time.monotonic()
+        faults.sleep_stage(obs.H2D)  # slow_stage=h2d:<ms> injection
         staged = tuple(self._put(a) for a in host_arrays)
         self.metrics.record_h2d_bytes(sum(a.nbytes for a in host_arrays), n)
         self.metrics.set_decode_queue_depth(self._decode_pool.queue_depth())
@@ -464,8 +467,10 @@ class InferenceEngine:
     def _finish(self, dispatched_item) -> list[list[dict]]:
         """Block on the fetch, threshold on host, record metrics."""
         outputs, n, t0, t_decode, t_pre, t_disp = dispatched_item
+        faults.sleep_stage(obs.DEVICE)  # slow_stage=device:<ms> injection
         scores, labels, boxes = jax.device_get(outputs)
         t_dev = time.monotonic()
+        faults.sleep_stage(obs.POSTPROCESS)
         out = [
             to_detections(
                 scores[j], labels[j], boxes[j], self.built.id2label, self.threshold
@@ -473,24 +478,27 @@ class InferenceEngine:
             for j in range(n)
         ]
         t_post = time.monotonic()
+        # Stage vocabulary is obs.STAGES everywhere (ISSUE 7 satellite —
+        # /metrics, bench JSON, and trace spans previously disagreed on
+        # "preprocess"/"staging" vs the decode+h2d split from PR 3):
+        # decode = decode-pool host work, h2d = device_put enqueue (the two
+        # knobs the ingest pipeline tunes), device = dispatch ->
+        # data-on-host (under pipelining the next chunk's host staging runs
+        # inside this span, but so does this chunk's compute — measuring
+        # from t_pre would bill the neighbor's staging as device time).
+        stage_windows = [
+            (obs.DECODE, t0, t_decode),
+            (obs.H2D, t_decode, t_pre),
+            (obs.DEVICE, t_disp, t_dev),
+            (obs.POSTPROCESS, t_dev, t_post),
+        ]
+        # fan the batch's stage windows out to every traced request in it
+        obs.record_engine_spans(stage_windows)
         self.metrics.record_batch(
             n,
             t_post - t0,
-            stages={
-                # "preprocess" = full host staging (kept for existing
-                # dashboards); decode/h2d split it into the decode-pool work
-                # and the device_put enqueue — the two knobs the ingest
-                # pipeline tunes (SPOTTER_TPU_DECODE_WORKERS vs uint8 H2D)
-                "preprocess": t_pre - t0,
-                "decode": t_decode - t0,
-                "h2d": t_pre - t_decode,
-                # dispatch -> data-on-host: the true device window. Under
-                # pipelining the next chunk's host staging runs inside this
-                # span, but so does this chunk's compute — measuring from
-                # t_pre instead would bill the neighbor's staging as device
-                # time (it starts before this chunk's fetch returns).
-                "device": t_dev - t_disp,
-                "postprocess": t_post - t_dev,
-            },
+            stages={name: t_end - t_start
+                    for name, t_start, t_end in stage_windows},
+            trace_id=obs.batch_trace_id(),
         )
         return out

@@ -9,11 +9,28 @@ import threading
 import time
 from collections import deque
 
+# Cumulative-histogram bucket bounds (ms) for batch latency — the
+# Prometheus-exposition view (ISSUE 7) renders these as
+# spotter_tpu_latency_ms_bucket{le="..."} with trace-id exemplars, so a
+# tail bucket links straight to the flight-recorder trace that landed in
+# it. The JSON snapshot carries them additively under
+# "latency_ms_histogram"; every pre-existing field is unchanged.
+LATENCY_BUCKETS_MS = (
+    5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+    float("inf"),
+)
+
 
 class Metrics:
     def __init__(self, window: int = 2048) -> None:
         self._lock = threading.Lock()
         self._latencies_ms: deque[float] = deque(maxlen=window)
+        self._latency_bucket_counts = [0] * len(LATENCY_BUCKETS_MS)
+        self._latency_sum_ms = 0.0
+        self._latency_count = 0
+        # le -> {"trace_id", "value", "ts"}: the most recent traced batch
+        # to land in each bucket (OpenMetrics exemplar shape)
+        self._latency_exemplars: dict[str, dict] = {}
         self._images_total = 0
         self._errors_total = 0
         self._batches_total = 0
@@ -76,14 +93,32 @@ class Metrics:
         batch_size: int,
         latency_s: float,
         stages: dict[str, float] | None = None,
+        trace_id: str | None = None,
     ) -> None:
-        """`stages`: optional per-stage seconds (e.g. preprocess/device/
-        postprocess) — the breakdown SURVEY.md §5.1 calls for."""
+        """`stages`: optional per-stage seconds keyed by the obs.STAGES
+        vocabulary (decode/h2d/device/postprocess) — the breakdown
+        SURVEY.md §5.1 calls for. `trace_id` (when the batch carried a
+        traced request) becomes the exemplar on the latency-histogram
+        bucket this batch landed in."""
+        latency_ms = latency_s * 1000.0
         with self._lock:
             self._images_total += batch_size
             self._batches_total += 1
             self._batch_sizes.append(batch_size)
-            self._latencies_ms.append(latency_s * 1000.0)
+            self._latencies_ms.append(latency_ms)
+            self._latency_sum_ms += latency_ms
+            self._latency_count += 1
+            for i, le in enumerate(LATENCY_BUCKETS_MS):
+                if latency_ms <= le:
+                    self._latency_bucket_counts[i] += 1
+                    if trace_id is not None:
+                        key = "+Inf" if le == float("inf") else f"{le:g}"
+                        self._latency_exemplars[key] = {
+                            "trace_id": trace_id,
+                            "value": latency_ms,
+                            "ts": time.time(),
+                        }
+                    break
             self._arrivals.append((time.monotonic(), batch_size))
             if stages:
                 for name, secs in stages.items():
@@ -227,8 +262,23 @@ class Metrics:
                             min(int(p * len(vals)), len(vals) - 1)
                         ]
 
+            # cumulative counts, Prometheus-style: bucket i covers <= le
+            cumulative = 0
+            buckets = []
+            for le, count in zip(LATENCY_BUCKETS_MS, self._latency_bucket_counts):
+                cumulative += count
+                buckets.append(
+                    [None if le == float("inf") else le, cumulative]
+                )
+
             return {
                 **stage_stats,
+                "latency_ms_histogram": {
+                    "buckets": buckets,
+                    "sum": self._latency_sum_ms,
+                    "count": self._latency_count,
+                    "exemplars": dict(self._latency_exemplars),
+                },
                 "h2d_bytes_total": self._h2d_bytes_total,
                 "h2d_bytes_per_image": (
                     self._h2d_bytes_total / self._h2d_images_total

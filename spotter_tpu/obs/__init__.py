@@ -1,0 +1,48 @@
+"""Observability tier (ISSUE 7): request-scoped tracing, flight recorder,
+Prometheus metrics view, and trace-correlated JSON logs.
+
+Import layering matters here: `obs.trace` and `obs.recorder` are
+stdlib-only (the supervisor and the jax-free engine error paths ride
+through them), while `obs.http` pulls in aiohttp and `obs.prom`/`obs.logs`
+stay stdlib. This package root re-exports only the stdlib-safe surface;
+HTTP glue is imported explicitly as `spotter_tpu.obs.http`.
+"""
+
+from spotter_tpu.obs.recorder import (  # noqa: F401
+    DUMP_EXIT_CODES,
+    TRACE_DUMP_DIR_ENV,
+    TRACE_RING_ENV,
+    TRACE_SLOWEST_K_ENV,
+    FlightRecorder,
+    dump_for_exit,
+    get_recorder,
+    reset_recorder,
+)
+from spotter_tpu.obs.trace import (  # noqa: F401
+    DECODE,
+    DEVICE,
+    ENGINE_STAGES,
+    FETCH,
+    H2D,
+    NETWORK,
+    OTHER,
+    POSTPROCESS,
+    QUEUE_WAIT,
+    REQUEST_ID_HEADER,
+    ROUTE,
+    STAGES,
+    TRACEPARENT_HEADER,
+    Trace,
+    batch_trace_id,
+    begin_trace,
+    current_trace,
+    new_request_id,
+    parse_traceparent,
+    record_engine_spans,
+    set_batch_traces,
+    set_current_trace,
+    span,
+    trace_id_for_request,
+    trace_stats,
+    traceparent_value,
+)

@@ -1,0 +1,203 @@
+"""HTTP-edge glue for the tracing tier: one implementation shared by the
+standalone server, the edge router, and the fleet edge.
+
+- request-id + traceparent extraction/minting, echoed on EVERY response —
+  success, 4xx/5xx, 429/503 sheds, and `PoolSuspendedError` fast-fails —
+  so client-side correlation works no matter how the request died;
+- `Server-Timing` emission (replica side) and parsing (router side): the
+  replica's per-stage span totals ride back on the response so the edge
+  can merge them into ONE trace whose summed spans reconcile with the
+  response latency the client saw;
+- the `/debug/traces` handler (admin-token-gated, exactly like /profile);
+- `/metrics` content negotiation between the unchanged JSON view and the
+  Prometheus text exposition.
+"""
+
+import os
+import re
+
+from aiohttp import web
+
+from spotter_tpu.obs import prom
+from spotter_tpu.obs.recorder import FlightRecorder, get_recorder
+from spotter_tpu.obs.trace import (
+    NETWORK,
+    OTHER,
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    Trace,
+    begin_trace,
+    traceparent_value,
+)
+
+ADMIN_TOKEN_ENV = "SPOTTER_TPU_ADMIN_TOKEN"
+ADMIN_TOKEN_HEADER = "X-Admin-Token"
+
+SERVER_TIMING_HEADER = "Server-Timing"
+
+_SERVER_TIMING_RE = re.compile(r"([A-Za-z0-9_.-]+);dur=([0-9.]+)")
+
+
+def admin_rejection(request: web.Request) -> web.Response | None:
+    """401 when SPOTTER_TPU_ADMIN_TOKEN is set and the request lacks it.
+
+    Read per request (not at app build) so rotation via env + restart of
+    the guard is trivial and tests cover both modes without rebuilding the
+    app. (Moved here from serving/standalone.py so /debug/traces on the
+    router gets the same guard as /drain and /profile on a replica.)
+    """
+    token = os.environ.get(ADMIN_TOKEN_ENV, "")
+    if not token:
+        return None  # open mode: no token configured
+    if request.headers.get(ADMIN_TOKEN_HEADER, "") == token:
+        return None
+    return web.json_response(
+        {"error": f"admin endpoint requires {ADMIN_TOKEN_HEADER}", "status": 401},
+        status=401,
+    )
+
+
+def begin_http_trace(request: web.Request) -> tuple[Trace | None, str]:
+    """Start (or decline) the request trace from the incoming headers and
+    install it in the handler's context. Returns (trace, request_id) —
+    request_id is minted when absent, present even with the recorder off,
+    and MUST be echoed on whatever response the handler produces."""
+    request_id = request.headers.get(REQUEST_ID_HEADER, "").strip()
+    if not request_id:
+        request_id = None
+    trace = begin_trace(
+        request_id=request_id,
+        traceparent=request.headers.get(TRACEPARENT_HEADER),
+        enabled=get_recorder().enabled,
+    )
+    if trace is not None:
+        return trace, trace.request_id
+    from spotter_tpu.obs.trace import new_request_id
+
+    return None, request_id or new_request_id()
+
+
+def forward_headers(trace: Trace | None, request_id: str,
+                    base: dict | None = None) -> dict:
+    """Headers for the downstream hop: the request id plus this trace's
+    span as the downstream parent (W3C traceparent)."""
+    headers = dict(base or {})
+    headers[REQUEST_ID_HEADER] = request_id
+    if trace is not None:
+        headers[TRACEPARENT_HEADER] = traceparent_value(trace)
+    return headers
+
+
+def finish_http_trace(
+    trace: Trace | None,
+    request_id: str,
+    response: web.Response,
+    recorder: FlightRecorder | None = None,
+    error: str | None = None,
+    server_timing: bool = False,
+) -> web.Response:
+    """Stamp correlation headers on the response and hand the completed
+    trace to the flight recorder. `error` pins the trace (shed/poison/
+    fatal classes ride in here); `server_timing=True` adds the per-stage
+    totals header the upstream edge merges."""
+    response.headers[REQUEST_ID_HEADER] = request_id
+    if trace is None:
+        return response
+    response.headers[TRACEPARENT_HEADER] = traceparent_value(trace)
+    if error is not None:
+        trace.set_error("error", error)
+    elif response.status in (429, 503):
+        trace.set_error("shed", f"HTTP {response.status}")
+    elif response.status >= 400:
+        trace.set_error("error", f"HTTP {response.status}")
+    total_ms = trace.finish()
+    if server_timing:
+        totals = trace.stage_totals()
+        if totals:
+            # "other" = this hop's unattributed remainder (HTTP parse/
+            # serialize, handler glue): reporting it keeps the upstream
+            # merge tiling — summed spans reconcile with the latency the
+            # edge measured instead of silently under-counting
+            unattributed = total_ms - sum(totals.values())
+            if unattributed > 0.0:
+                totals[OTHER] = unattributed
+            response.headers[SERVER_TIMING_HEADER] = ", ".join(
+                f"{name};dur={dur:.3f}" for name, dur in totals.items()
+            )
+    (recorder or get_recorder()).record(trace)
+    return response
+
+
+def merge_server_timing(trace: Trace | None, header_value: str | None) -> float:
+    """Fold a downstream hop's Server-Timing totals into this trace (start
+    offsets are not carried — only the durations matter for attribution).
+    Returns the summed downstream milliseconds."""
+    if not header_value:
+        return 0.0
+    total = 0.0
+    for name, dur in _SERVER_TIMING_RE.findall(header_value):
+        try:
+            dur_ms = float(dur)
+        except ValueError:
+            continue
+        total += dur_ms
+        if trace is not None:
+            trace.add_span_ms(name, 0.0, dur_ms)
+    return total
+
+
+def merge_downstream(
+    trace: Trace | None, response_headers, elapsed_s: float
+) -> None:
+    """Attribute one downstream call on the edge trace: merge the hop's
+    Server-Timing totals, then book the remainder of the await window —
+    transport, connection churn, the downstream server's pre/post-handler
+    framing — as a `network` span (the classic client-duration minus
+    server-duration slice). With this, an edge trace tiles: route spans +
+    downstream stages + network ≈ the latency the client saw."""
+    if trace is None:
+        return
+    merged = merge_server_timing(
+        trace, response_headers.get(SERVER_TIMING_HEADER)
+    )
+    net_ms = elapsed_s * 1e3 - merged
+    if net_ms > 0.0:
+        trace.add_span_ms(NETWORK, 0.0, net_ms)
+
+
+def make_debug_traces_handler(recorder: FlightRecorder | None = None):
+    """GET /debug/traces (admin-token-gated): the full flight-recorder
+    snapshot, or `?request_id=<id>` / `?trace_id=<id>` for one request's
+    trace(s)."""
+
+    async def debug_traces(request: web.Request) -> web.Response:
+        rejected = admin_rejection(request)
+        if rejected is not None:
+            return rejected
+        rec = recorder or get_recorder()
+        key = (
+            request.query.get("request_id", "").strip()
+            or request.query.get("trace_id", "").strip()
+        )
+        if key:
+            matches = rec.lookup(key)
+            return web.json_response(
+                {"query": key, "traces": matches},
+                status=200 if matches else 404,
+            )
+        return web.json_response(rec.snapshot())
+
+    return debug_traces
+
+
+def metrics_response(request: web.Request, snapshot: dict) -> web.Response:
+    """JSON by default (unchanged for existing consumers); Prometheus text
+    exposition behind `?format=prometheus` or `Accept: text/plain`."""
+    if prom.wants_prometheus(
+        request.query.get("format"), request.headers.get("Accept")
+    ):
+        return web.Response(
+            text=prom.render(snapshot), content_type="text/plain",
+            charset="utf-8", headers={"X-Prometheus-Version": "0.0.4"},
+        )
+    return web.json_response(snapshot)

@@ -70,6 +70,7 @@ try:
 except ImportError:  # minimal image — fallback loop below keeps the contract
     _HAVE_TENACITY = False
 
+from spotter_tpu import obs
 from spotter_tpu.caching.result_cache import ResultCache, content_key, url_key
 from spotter_tpu.caching.singleflight import SingleFlight
 from spotter_tpu.engine.batcher import MicroBatcher
@@ -287,28 +288,35 @@ class AmenitiesDetector:
     async def _process_single_image(
         self, url: str, deadline: Deadline | None = None
     ) -> ImageResult:
+        # the ambient request trace (ISSUE 7): span capture below is a
+        # monotonic read + list append per stage; None (recorder off, or a
+        # bare library call) makes every `with obs.span(...)` a no-op
+        trace = obs.current_trace()
         try:
-            image_bytes = await self._fetch_for_request(url, deadline)
+            with obs.span(obs.FETCH, trace):
+                image_bytes = await self._fetch_for_request(url, deadline)
 
-            cache_key: str | None = None
-            raw_detections: list[dict] | None = None
-            if self.cache is not None:
-                cache_key = content_key(
-                    self._cache_model, image_bytes, self._cache_threshold
-                )
-                # repeat poison: re-raise the cached verdict instead of
-                # letting the same bytes re-poison a batch through the
-                # bisect machinery
-                cached_failure = self.cache.get_negative(cache_key)
-                if cached_failure is not None:
-                    raise cached_failure
-                raw_detections = self.cache.get(cache_key)
+            with obs.span(obs.DECODE, trace):
+                cache_key: str | None = None
+                raw_detections: list[dict] | None = None
+                if self.cache is not None:
+                    cache_key = content_key(
+                        self._cache_model, image_bytes, self._cache_threshold
+                    )
+                    # repeat poison: re-raise the cached verdict instead of
+                    # letting the same bytes re-poison a batch through the
+                    # bisect machinery
+                    cached_failure = self.cache.get_negative(cache_key)
+                    if cached_failure is not None:
+                        raise cached_failure
+                    raw_detections = self.cache.get(cache_key)
 
-            with Image.open(BytesIO(image_bytes)) as img_raw:
-                # decode-bomb guard: the header-declared pixel count is
-                # checked BEFORE convert() decodes anything (preprocess.py)
-                check_image_pixels(img_raw)
-                image = img_raw.convert("RGB")
+                with Image.open(BytesIO(image_bytes)) as img_raw:
+                    # decode-bomb guard: the header-declared pixel count is
+                    # checked BEFORE convert() decodes anything
+                    # (preprocess.py)
+                    check_image_pixels(img_raw)
+                    image = img_raw.convert("RGB")
 
             if raw_detections is None:
                 # miss: the content hash rides into the batcher for
@@ -317,43 +325,56 @@ class AmenitiesDetector:
                     image, deadline=deadline, key=cache_key
                 )
 
-            draw = ImageDraw.Draw(image)
-            image_detections: list[DetectionResult] = []
-            for det in raw_detections:
-                amenity = AMENITIES_MAPPING.get(det["label"])
-                if amenity is None:
-                    continue
-                box = det["box"]
-                draw.rectangle(box, outline="red", width=3)
-                draw.text(
-                    xy=(box[0] + 5, box[1] + 5),
-                    text=amenity,
-                    fill="white",
-                    stroke_width=1,
-                    stroke_fill="black",
-                )
-                image_detections.append(DetectionResult(label=amenity, box=box))
+            with obs.span(obs.POSTPROCESS, trace):
+                draw = ImageDraw.Draw(image)
+                image_detections: list[DetectionResult] = []
+                for det in raw_detections:
+                    amenity = AMENITIES_MAPPING.get(det["label"])
+                    if amenity is None:
+                        continue
+                    box = det["box"]
+                    draw.rectangle(box, outline="red", width=3)
+                    draw.text(
+                        xy=(box[0] + 5, box[1] + 5),
+                        text=amenity,
+                        fill="white",
+                        stroke_width=1,
+                        stroke_fill="black",
+                    )
+                    image_detections.append(
+                        DetectionResult(label=amenity, box=box)
+                    )
 
-            buffer = BytesIO()
-            image.save(buffer, format="JPEG")
-            image_b64 = base64.b64encode(buffer.getvalue()).decode("utf-8")
+                buffer = BytesIO()
+                image.save(buffer, format="JPEG")
+                image_b64 = base64.b64encode(buffer.getvalue()).decode("utf-8")
 
             return DetectionSuccessResult(
                 url=url, detections=image_detections, labeled_image_base64=image_b64
             )
         except DeadlineExceededError as e:
             # structured, bounded-time answer — never a hang (ISSUE 1)
+            if trace is not None:
+                trace.set_error("deadline", str(e))
             return DetectionErrorResult(url=url, error=f"Deadline exceeded: {e}")
         except AdmissionError:
             # propagate so detect() can turn a fully-shed request into
             # HTTP 429/503; partially-shed requests degrade per image there
             raise
         except FetchError as e:
+            if trace is not None:
+                trace.set_error("fetch_error", str(e))
             return DetectionErrorResult(url=url, error=f"Fetch Error: {e}")
         except httpx.HTTPError as e:
+            if trace is not None:
+                trace.set_error("fetch_error", str(e))
             return DetectionErrorResult(url=url, error=f"HTTP Error: {e}")
         except Exception as e:
             tb_str = traceback.format_exc()
+            if trace is not None:
+                # poison/engine failures pin the trace in the flight
+                # recorder's error set under their exception type
+                trace.set_error(type(e).__name__, str(e))
             return DetectionErrorResult(url=url, error=f"Processing Error: {e}\n{tb_str}")
 
     async def detect(

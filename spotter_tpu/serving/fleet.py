@@ -56,6 +56,9 @@ from typing import Callable, Optional, Protocol
 
 from aiohttp import web
 
+from spotter_tpu import obs
+from spotter_tpu.obs import http as obs_http
+from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.serving.replica_pool import (
     PoolExhaustedError,
     ReplicaPool,
@@ -383,7 +386,13 @@ class FleetController:
         for _ in range(missing):
             self._spawn(fp)
 
-    async def request(self, path: str, payload: dict, cls: Optional[str] = None):
+    async def request(
+        self,
+        path: str,
+        payload: dict,
+        cls: Optional[str] = None,
+        headers: Optional[dict] = None,
+    ):
         """Route one classed request through its pool, waking a
         scaled-to-zero pool on the way. Bulk requests tolerate a bounded
         wait for a restoring/stormed pool; SLO requests fail fast (the
@@ -415,7 +424,7 @@ class FleetController:
                         pass
             fp.last_used = time.monotonic()
         try:
-            return await fp.pool.request(path, payload)
+            return await fp.pool.request(path, payload, headers=headers)
         except PoolExhaustedError:
             self.class_failures[cls] += 1
             raise
@@ -659,26 +668,50 @@ def make_fleet_app(controller: FleetController) -> web.Application:
         await controller.stop()
 
     async def detect(request: web.Request) -> web.Response:
-        try:
-            payload = await request.json()
-        except json.JSONDecodeError:
-            return web.Response(status=400, text="Invalid JSON body")
-        cls, payload = classify_request(
-            request.headers, payload, default=controller.default_class
-        )
-        try:
-            resp = await controller.request("/detect", payload, cls)
-        except PoolExhaustedError as exc:
-            return web.json_response(
-                {"error": str(exc), "status": 503, "request_class": cls},
-                status=503,
-                headers=retry_after_header(exc),
+        # Same edge-trace contract as the plain router (ISSUE 7): ids
+        # minted/continued and echoed on EVERY outcome (storm 503s
+        # included), traceparent forwarded, replica Server-Timing merged
+        # behind a route span that also covers the pool pick.
+        trace, request_id = obs_http.begin_http_trace(request)
+
+        def done(resp: web.Response) -> web.Response:
+            return obs_http.finish_http_trace(
+                trace, request_id, resp, server_timing=True
             )
-        return web.Response(
-            status=resp.status_code,
-            body=resp.content,
-            content_type="application/json",
-        )
+
+        with obs.span(obs.ROUTE, trace):
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                return done(web.Response(status=400, text="Invalid JSON body"))
+            cls, payload = classify_request(
+                request.headers, payload, default=controller.default_class
+            )
+        t_fwd = time.monotonic()
+        try:
+            resp = await controller.request(
+                "/detect", payload, cls,
+                headers=obs_http.forward_headers(trace, request_id),
+            )
+        except PoolExhaustedError as exc:
+            return done(
+                web.json_response(
+                    {"error": str(exc), "status": 503, "request_class": cls},
+                    status=503,
+                    headers=retry_after_header(exc),
+                )
+            )
+        elapsed_s = time.monotonic() - t_fwd
+        with obs.span(obs.ROUTE, trace):
+            # replica stages + the transport remainder as a network span:
+            # the edge trace tiles against the latency the client saw
+            obs_http.merge_downstream(trace, resp.headers, elapsed_s)
+            out = web.Response(
+                status=resp.status_code,
+                body=resp.content,
+                content_type="application/json",
+            )
+        return done(out)
 
     async def healthz(request: web.Request) -> web.Response:
         available = {
@@ -694,12 +727,15 @@ def make_fleet_app(controller: FleetController) -> web.Application:
         return web.json_response({"status": "alive"})
 
     async def metrics(request: web.Request) -> web.Response:
-        return web.json_response(controller.snapshot())
+        # JSON unchanged; Prometheus text exposition of the pool_size /
+        # preemption / replay gauges behind the standard negotiation
+        return obs_http.metrics_response(request, controller.snapshot())
 
     app.router.add_post("/detect", detect)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/livez", livez)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/traces", obs_http.make_debug_traces_handler())
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app
@@ -743,6 +779,7 @@ def main() -> None:
     if not on_demand and not spot:
         raise SystemExit("no endpoints: pass --on-demand and/or --spot")
     logging.basicConfig(level=logging.INFO)
+    obs_logs.maybe_setup_json_logging()
     controller = static_fleet(on_demand, spot)
     web.run_app(make_fleet_app(controller), host=args.host, port=args.port)
 

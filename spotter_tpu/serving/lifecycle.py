@@ -260,6 +260,12 @@ class PreemptionWatcher:
             await self.on_preempt()
         except Exception:
             logger.exception("drain during preemption failed; exiting anyway")
+        # flight-recorder post-mortem (ISSUE 7): the in-memory trace ring
+        # dies with the process — persist it so "what was in flight when
+        # the preemption landed" is answerable after the restart
+        from spotter_tpu.obs.recorder import dump_for_exit
+
+        dump_for_exit(PREEMPTED_EXIT_CODE)
         self.exit_cb(PREEMPTED_EXIT_CODE)
 
     async def stop(self) -> None:

@@ -370,12 +370,19 @@ class ReplicaPool:
             retry_after_s=retry_after,
         )
 
-    async def _attempt(self, r: Replica, path: str, payload: dict):
+    async def _attempt(
+        self, r: Replica, path: str, payload: dict,
+        headers: Optional[dict] = None,
+    ):
         r.requests += 1
-        resp = await self.client.post(f"{r.url}{path}", json=payload)
+        resp = await self.client.post(
+            f"{r.url}{path}", json=payload, headers=headers
+        )
         return resp
 
-    async def request(self, path: str, payload: dict) -> httpx.Response:
+    async def request(
+        self, path: str, payload: dict, headers: Optional[dict] = None
+    ) -> httpx.Response:
         """POST `payload` with failover: try each distinct replica at most
         once per round, replaying on transport errors and replayable
         statuses; after a fully-failed round, pause briefly and run up to
@@ -422,9 +429,11 @@ class ReplicaPool:
                 tried.add(r.url)
                 try:
                     if self.hedge_after_s is not None and attempt == 0:
-                        resp = await self._hedged_attempt(r, tried, path, payload)
+                        resp = await self._hedged_attempt(
+                            r, tried, path, payload, headers
+                        )
                     else:
-                        resp = await self._attempt(r, path, payload)
+                        resp = await self._attempt(r, path, payload, headers)
                 except Exception as exc:  # connect/reset/timeout — kill signature
                     self._record_failure(r, repr(exc))
                     last_err = f"{r.url}: {exc!r}"
@@ -446,13 +455,14 @@ class ReplicaPool:
         )
 
     async def _hedged_attempt(
-        self, first: Replica, tried: set[str], path: str, payload: dict
+        self, first: Replica, tried: set[str], path: str, payload: dict,
+        headers: Optional[dict] = None,
     ) -> httpx.Response:
         """Fire at `first`; if no answer within hedge_after_s, also fire at a
         second replica and take whichever succeeds first (the loser is
         cancelled). An error from every in-flight attempt propagates so
         request()'s replay logic treats it like an unhedged failure."""
-        primary = asyncio.create_task(self._attempt(first, path, payload))
+        primary = asyncio.create_task(self._attempt(first, path, payload, headers))
         done, _ = await asyncio.wait({primary}, timeout=self.hedge_after_s)
         if done:
             return primary.result()  # success or raise-through to replay
@@ -460,7 +470,9 @@ class ReplicaPool:
         if backup_replica is None:  # nowhere to hedge: wait the primary out
             return await primary
         self.hedges_total += 1
-        backup = asyncio.create_task(self._attempt(backup_replica, path, payload))
+        backup = asyncio.create_task(
+            self._attempt(backup_replica, path, payload, headers)
+        )
         pending = {primary, backup}
         last_exc: Optional[BaseException] = None
         while pending:

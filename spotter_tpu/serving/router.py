@@ -33,6 +33,9 @@ import time
 
 from aiohttp import web
 
+from spotter_tpu import obs
+from spotter_tpu.obs import http as obs_http
+from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.serving.fleet import retry_after_header
 from spotter_tpu.serving.replica_pool import PoolExhaustedError, ReplicaPool
 
@@ -54,23 +57,49 @@ def make_router_app(pool: ReplicaPool) -> web.Application:
         await pool.stop()
 
     async def detect(request: web.Request) -> web.Response:
-        try:
-            payload = await request.json()
-        except json.JSONDecodeError:
-            return web.Response(status=400, text="Invalid JSON body")
-        try:
-            resp = await pool.request("/detect", payload)
-        except PoolExhaustedError as exc:
-            return web.json_response(
-                {"error": str(exc), "status": 503},
-                status=503,
-                headers=retry_after_header(exc),
+        # Edge half of the trace (ISSUE 7): mint/continue the ids, forward
+        # traceparent + X-Request-ID to the replica, and merge the
+        # replica's Server-Timing back so ONE trace carries route + every
+        # replica stage. X-Request-ID is echoed on every outcome —
+        # PoolSuspendedError fast-fails included.
+        trace, request_id = obs_http.begin_http_trace(request)
+
+        def done(resp: web.Response) -> web.Response:
+            return obs_http.finish_http_trace(
+                trace, request_id, resp, server_timing=True
             )
-        return web.Response(
-            status=resp.status_code,
-            body=resp.content,
-            content_type="application/json",
-        )
+
+        with obs.span(obs.ROUTE, trace):
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                return done(web.Response(status=400, text="Invalid JSON body"))
+        t_fwd = time.monotonic()
+        try:
+            resp = await pool.request(
+                "/detect",
+                payload,
+                headers=obs_http.forward_headers(trace, request_id),
+            )
+        except PoolExhaustedError as exc:
+            return done(
+                web.json_response(
+                    {"error": str(exc), "status": 503},
+                    status=503,
+                    headers=retry_after_header(exc),
+                )
+            )
+        elapsed_s = time.monotonic() - t_fwd
+        with obs.span(obs.ROUTE, trace):
+            # replica stages + the transport remainder as a network span:
+            # the edge trace tiles against the latency the client saw
+            obs_http.merge_downstream(trace, resp.headers, elapsed_s)
+            out = web.Response(
+                status=resp.status_code,
+                body=resp.content,
+                content_type="application/json",
+            )
+        return done(out)
 
     async def healthz(request: web.Request) -> web.Response:
         now = time.monotonic()
@@ -84,12 +113,15 @@ def make_router_app(pool: ReplicaPool) -> web.Application:
         return web.json_response({"status": "alive"})
 
     async def metrics(request: web.Request) -> web.Response:
-        return web.json_response(pool.snapshot())
+        # JSON unchanged; ?format=prometheus / Accept: text/plain for the
+        # text exposition of the same pool gauges (ISSUE 7)
+        return obs_http.metrics_response(request, pool.snapshot())
 
     app.router.add_post("/detect", detect)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/livez", livez)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/traces", obs_http.make_debug_traces_handler())
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app
@@ -125,6 +157,7 @@ def main() -> None:
     if not endpoints and not spot_endpoints:
         raise SystemExit(f"no replica endpoints: pass --endpoints or set {REPLICAS_ENV}")
     logging.basicConfig(level=logging.INFO)
+    obs_logs.maybe_setup_json_logging()
     if spot_endpoints:
         from spotter_tpu.serving.fleet import make_fleet_app, static_fleet
 

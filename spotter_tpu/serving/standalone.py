@@ -45,6 +45,8 @@ import tempfile
 import pydantic
 from aiohttp import web
 
+from spotter_tpu.obs import http as obs_http
+from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.ops import preprocess
 from spotter_tpu.serving import lifecycle
 from spotter_tpu.serving.resilience import AdmissionError
@@ -52,8 +54,11 @@ from spotter_tpu.testing import faults, stub_engine
 
 logger = logging.getLogger(__name__)
 
-ADMIN_TOKEN_ENV = "SPOTTER_TPU_ADMIN_TOKEN"
-ADMIN_TOKEN_HEADER = "X-Admin-Token"
+# Back-compat aliases: the admin guard moved to obs/http.py (ISSUE 7) so
+# /debug/traces on the router shares it; existing imports keep working.
+ADMIN_TOKEN_ENV = obs_http.ADMIN_TOKEN_ENV
+ADMIN_TOKEN_HEADER = obs_http.ADMIN_TOKEN_HEADER
+_admin_rejection = obs_http.admin_rejection
 
 
 def _rmdir_quiet(path: str) -> None:
@@ -77,23 +82,6 @@ def _not_ready_response(tracker: lifecycle.StartupTracker) -> web.Response:
         {"error": f"replica starting up ({tracker.state})", "status": 503},
         status=503,
         headers={"Retry-After": "2"},
-    )
-
-
-def _admin_rejection(request: web.Request) -> web.Response | None:
-    """401 when SPOTTER_TPU_ADMIN_TOKEN is set and the request lacks it.
-
-    Read per request (not at app build) so rotation via env + restart of the
-    guard is trivial and tests cover both modes without rebuilding the app.
-    """
-    token = os.environ.get(ADMIN_TOKEN_ENV, "")
-    if not token:
-        return None  # open mode: no token configured
-    if request.headers.get(ADMIN_TOKEN_HEADER, "") == token:
-        return None
-    return web.json_response(
-        {"error": f"admin endpoint requires {ADMIN_TOKEN_HEADER}", "status": 401},
-        status=401,
     )
 
 
@@ -200,26 +188,37 @@ def make_app(
             await watcher.start()
 
     async def detect(request: web.Request) -> web.Response:
+        # Request-scoped trace (ISSUE 7): continue the edge's traceparent or
+        # mint ids from/with X-Request-ID; EVERY branch below — sheds
+        # included — echoes the request id, and completed traces land in
+        # the flight recorder with per-stage Server-Timing on the response.
+        trace, request_id = obs_http.begin_http_trace(request)
+
+        def done(resp: web.Response) -> web.Response:
+            return obs_http.finish_http_trace(
+                trace, request_id, resp, server_timing=True
+            )
+
         det = request.app["detector"]
         if det is None:  # still loading/warming: shed, probe /startupz
-            return _not_ready_response(tracker)
+            return done(_not_ready_response(tracker))
         shed = det.check_admission()
         if shed is not None:  # draining / breaker open: reject before fetching
-            return _shed_response(shed)
+            return done(_shed_response(shed))
         try:
             payload = await request.json()
         except json.JSONDecodeError:
-            return web.Response(status=400, text="Invalid JSON body")
+            return done(web.Response(status=400, text="Invalid JSON body"))
         try:
             response = await det.detect(payload)
         except pydantic.ValidationError as exc:
-            return web.Response(status=400, text=f"Invalid request: {exc}")
+            return done(web.Response(status=400, text=f"Invalid request: {exc}"))
         except AdmissionError as exc:  # every image shed -> 429/503
-            return _shed_response(exc)
+            return done(_shed_response(exc))
         except Exception:
             logger.exception("detect failed")
-            return web.Response(status=500, text="Internal server error")
-        return web.json_response(response.model_dump())
+            return done(web.Response(status=500, text="Internal server error"))
+        return done(web.json_response(response.model_dump()))
 
     async def startupz(request: web.Request) -> web.Response:
         """Startup probe: 200 only once the replica reached ready. A long
@@ -259,8 +258,12 @@ def make_app(
     async def metrics(request: web.Request) -> web.Response:
         det = request.app["detector"]
         if det is None:
-            return web.json_response({"startup": tracker.snapshot()})
-        return web.json_response(det.engine.metrics.snapshot())
+            return obs_http.metrics_response(
+                request, {"startup": tracker.snapshot()}
+            )
+        # JSON view unchanged for existing consumers; ?format=prometheus or
+        # Accept: text/plain selects the text exposition (ISSUE 7)
+        return obs_http.metrics_response(request, det.engine.metrics.snapshot())
 
     async def profile(request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of in-flight device work.
@@ -322,6 +325,8 @@ def make_app(
     app.router.add_post("/drain", drain)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/profile", profile)
+    # flight-recorder view (ISSUE 7): admin-token-gated like /profile
+    app.router.add_get("/debug/traces", obs_http.make_debug_traces_handler())
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app
@@ -368,6 +373,9 @@ def main() -> None:
     )
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    # SPOTTER_TPU_LOG_JSON=1: structured logs carrying the trace/request id
+    # of whatever request was active when the line was emitted (ISSUE 7)
+    obs_logs.maybe_setup_json_logging()
     if args.stub_engine:
         os.environ[stub_engine.STUB_ENGINE_ENV] = "1"
     # ingest/topology flags land in the env: bring-up (and any supervisor

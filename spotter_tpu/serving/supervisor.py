@@ -242,6 +242,12 @@ class Supervisor:
                             "uptime; giving up",
                             consecutive_fast_crashes, self.min_uptime_s,
                         )
+                        # persist whatever the supervisor-side flight
+                        # recorder holds (ISSUE 7; usually empty — the
+                        # replica's own ring dumps on 83/85 in-process)
+                        from spotter_tpu.obs.recorder import dump_for_exit
+
+                        dump_for_exit(CRASH_LOOP_EXIT_CODE)
                         return CRASH_LOOP_EXIT_CODE
                 wait_s = self._bump_backoff()
                 logger.warning(

@@ -90,6 +90,13 @@ class FaultPlan:
     # the controller's next tick (consumed whole, not one-by-one — a storm
     # is one correlated event)
     preempt_storm: int = 0
+    # ISSUE 7 observability tier: "<stage>:<ms>" injects that much latency
+    # into the named pipeline stage (obs.STAGES vocabulary: fetch, decode,
+    # queue_wait, h2d, device, postprocess, route) on EVERY pass through it
+    # while the plan is active, so trace/SLO tests can assert attribution
+    # deterministically ("the device span grew by exactly the injected
+    # amount"). Multiple stages: ";"-separated pairs.
+    slow_stage: str = ""
     # set() to un-wedge hanging engine calls early (tests)
     release: threading.Event = field(default_factory=threading.Event)
     _lock: threading.Lock = field(default_factory=threading.Lock)
@@ -150,14 +157,60 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "shard_dead",
             "cache_error",
             "preempt_storm",
+            "slow_stage",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
+        if key == "slow_stage":
+            kwargs[key] = value.strip()
+            _parse_slow_stage(kwargs[key])  # fail loudly at activation
+            continue
         try:
             kwargs[key] = float(value) if key.endswith("_s") else int(value)
         except ValueError:
             raise ValueError(f"bad {FAULTS_ENV} entry {part!r}") from None
     _active = FaultPlan(**kwargs)
     return _active
+
+
+def _parse_slow_stage(spec: str) -> dict[str, float]:
+    """`"device:100"` (or `"device:100;fetch:25"`) -> {stage: seconds}."""
+    delays: dict[str, float] = {}
+    for pair in spec.split(";"):
+        pair = pair.strip()
+        if not pair:
+            continue
+        stage, sep, ms = pair.partition(":")
+        if not sep:
+            raise ValueError(
+                f"bad slow_stage entry {pair!r}: expected <stage>:<ms>"
+            )
+        try:
+            delays[stage.strip()] = float(ms) / 1000.0
+        except ValueError:
+            raise ValueError(
+                f"bad slow_stage entry {pair!r}: ms must be a number"
+            ) from None
+    return delays
+
+
+def stage_delay_s(stage: str) -> float:
+    """Injected latency (seconds) for a named pipeline stage; 0.0 when no
+    plan is active — the usual single None check on the production path."""
+    plan = _active
+    if plan is None or not plan.slow_stage:
+        return 0.0
+    return _parse_slow_stage(plan.slow_stage).get(stage, 0.0)
+
+
+def sleep_stage(stage: str) -> None:
+    """Blocking form for worker-thread stage sites (the engine's staging/
+    fetch/postprocess windows run in threads, so a sleep is attributable
+    and harmless)."""
+    delay = stage_delay_s(stage)
+    if delay > 0.0:
+        import time
+
+        time.sleep(delay)
 
 
 async def on_fetch(url: str) -> bytes | None:

@@ -53,11 +53,41 @@ class StubEngine:
         pass
 
     def detect(self, images):
+        # Mirror the real engine's stage-window accounting (obs.STAGES
+        # vocabulary, ISSUE 7): the stub's "device" window is its service
+        # sleep, the other engine stages are real-but-tiny, and the
+        # slow_stage fault injects into the same seams — so fleet/trace
+        # tests over stub replicas see the same span set (and the same
+        # /metrics stage histograms) the production engine emits.
+        from spotter_tpu import obs
+        from spotter_tpu.testing import faults
+
         t0 = time.monotonic()
+        faults.sleep_stage(obs.DECODE)
+        t_decode = time.monotonic()
+        faults.sleep_stage(obs.H2D)
+        t_h2d = time.monotonic()
+        faults.sleep_stage(obs.DEVICE)
         if self.service_s > 0:
             time.sleep(self.service_s)
+        t_dev = time.monotonic()
+        faults.sleep_stage(obs.POSTPROCESS)
         out = [list(STUB_DETECTIONS) for _ in images]
-        self.metrics.record_batch(len(images), time.monotonic() - t0)
+        t_post = time.monotonic()
+        stage_windows = [
+            (obs.DECODE, t0, t_decode),
+            (obs.H2D, t_decode, t_h2d),
+            (obs.DEVICE, t_h2d, t_dev),
+            (obs.POSTPROCESS, t_dev, t_post),
+        ]
+        obs.record_engine_spans(stage_windows)
+        self.metrics.record_batch(
+            len(images),
+            t_post - t0,
+            stages={name: t_end - t_start
+                    for name, t_start, t_end in stage_windows},
+            trace_id=obs.batch_trace_id(),
+        )
         return out
 
 

@@ -199,9 +199,14 @@ def test_metrics_expose_ingest_pipeline(monkeypatch):
             assert snap["h2d_bytes_total"] > 0
             assert snap["h2d_bytes_per_image"] > 0
             assert "decode_pool_queue_depth" in snap
-            for stage in ("preprocess", "decode", "h2d", "device"):
+            # the unified obs.STAGES vocabulary (ISSUE 7 satellite): the
+            # old "preprocess" alias is gone — decode + h2d ARE staging
+            from spotter_tpu import obs
+
+            for stage in obs.ENGINE_STAGES:
                 for tag in ("p50", "p90", "p99"):
                     assert f"stage_{stage}_ms_{tag}" in snap
+            assert "stage_preprocess_ms_p50" not in snap
 
     asyncio.run(run())
 
