@@ -117,23 +117,38 @@ class ResultCache:
     def get(self, key: str) -> Optional[list]:
         """Detections for `key`, or None. Counts a hit/miss; returns a COPY
         of the stored list so no two requests share mutable state."""
+        return self.get_entry(key)[0]
+
+    def get_entry(self, key: str, stale_ok: bool = False) -> tuple[Optional[list], bool]:
+        """(detections, is_stale) for `key`, or (None, False).
+
+        `stale_ok=True` (the brownout serve-stale rung, ISSUE 8) makes an
+        expired-TTL entry acceptable: it is returned with `is_stale=True`
+        and KEPT (the brownout may clear before the next request; the LRU/
+        byte budget still bounds it) instead of dropped. The fresh path is
+        unchanged: expired entries are dropped and miss.
+        """
         try:
             faults.on_cache("get", key)
             with self._lock:
                 entry = self._entries.get(key)
-                if entry is not None and entry[2] <= self._clock():
+                stale = entry is not None and entry[2] <= self._clock()
+                if stale and not stale_ok:
                     self._drop(key)
                     entry = None
+                    stale = False
                 if entry is None:
                     self._record("record_cache_miss")
-                    return None
+                    return None, False
                 self._entries.move_to_end(key)
                 self._record("record_cache_hit")
-                return [dict(d) for d in entry[0]]
+                if stale:
+                    self._record("record_stale_served")
+                return [dict(d) for d in entry[0]], stale
         except Exception:
             logger.exception("result cache get(%s) failed; treating as miss", key)
             self._record("record_cache_miss")
-            return None
+            return None, False
 
     def put(self, key: str, detections: list) -> None:
         """Fill (idempotent; last writer wins). Oversized values — bigger

@@ -45,6 +45,20 @@ exactly once. On completion the optional `result_cache` is filled (success
 fatal/transient engine errors are NEVER cached). Unkeyed submits take the
 exact pre-cache path, so `SPOTTER_TPU_CACHE_MAX_MB=0` keeps serving
 bit-identical to a cache-less build.
+
+Overload control (ISSUE 8, opt-in via `SPOTTER_TPU_ADMIT_TARGET_MS`): the
+static queue-depth shed is replaced by an AIMD adaptive concurrency
+limiter driven by measured queue_wait p90 (the queue becomes unbounded;
+the limiter is the bound). Admission is class-aware — `submit(..., cls=
+"bulk")` entries shed strictly before slo: a bulk arrival over the limit
+sheds 429 immediately, while an slo arrival first revokes the NEWEST
+queued bulk entry (its future fails with `QueueFullError`; the pump skips
+done futures) and takes its slot. A `BrownoutController` rides along:
+under sustained saturation it caps the dispatch bucket one rung down
+(rung 2) and shed ALL bulk with 503 (rung 4); the detector layer consumes
+the stale-serve (rung 1) and threshold (rung 3) effects. With the knob
+unset both are None and admission is bit-identical to the static build
+(test-asserted).
 """
 
 import asyncio
@@ -64,6 +78,15 @@ from spotter_tpu.engine.errors import (
     PoisonImageError,
     TransientEngineError,
 )
+from spotter_tpu.serving.overload import (
+    BULK,
+    SLO,
+    AdaptiveLimiter,
+    AdmitLimitError,
+    BrownoutController,
+    BrownoutShedError,
+    build_overload_control,
+)
 from spotter_tpu.serving.resilience import (
     BATCH_TIMEOUT_ENV,
     DEFAULT_BATCH_TIMEOUT_MS,
@@ -78,10 +101,16 @@ from spotter_tpu.serving.resilience import (
     QueueFullError,
     _env_float,
     _env_int,
+    jittered_retry_after,
 )
 from spotter_tpu.testing import faults
 
 logger = logging.getLogger(__name__)
+
+# default for MicroBatcher(limiter=/brownout=...): build from the env knobs
+# (None when SPOTTER_TPU_ADMIT_TARGET_MS is unset/0). Pass None to force
+# the overload-control tier off regardless of the env.
+_FROM_ENV = object()
 
 
 class BatchTimeoutError(RuntimeError):
@@ -104,6 +133,8 @@ class MicroBatcher:
         poison_max_splits: Optional[int] = None,
         fatal_exit_cb: Optional[Callable[[int], None]] = None,
         result_cache=None,
+        limiter: Optional[AdaptiveLimiter] = _FROM_ENV,
+        brownout: Optional[BrownoutController] = _FROM_ENV,
     ) -> None:
         """`max_queue`/`batch_timeout_ms` default from the env knobs
         (`SPOTTER_TPU_QUEUE_DEPTH`, `SPOTTER_TPU_BATCH_TIMEOUT_MS`);
@@ -145,14 +176,32 @@ class MicroBatcher:
         self.poison_max_splits = poison_max_splits
         self.fatal_exit_cb = fatal_exit_cb
         self.result_cache = result_cache
+        # Overload control (ISSUE 8): both default from the env —
+        # SPOTTER_TPU_ADMIT_TARGET_MS unset/0 leaves them None and every
+        # admission below takes the exact static queue-depth path. With the
+        # limiter armed, the queue is unbounded: the adaptive limit IS the
+        # bound, and the static depth would otherwise second-guess it.
+        if limiter is _FROM_ENV or brownout is _FROM_ENV:
+            env_limiter, env_brownout = build_overload_control(
+                metrics=engine.metrics
+            )
+            if limiter is _FROM_ENV:
+                limiter = env_limiter
+            if brownout is _FROM_ENV:
+                brownout = env_brownout
+        self.limiter = limiter
+        self.brownout = brownout
         # key -> (primary future, waiter futures): one queue entry per key,
         # its result fanned to every waiter when the primary settles
         self._keyed: dict[str, tuple[asyncio.Future, list[asyncio.Future]]] = {}
         self._lifecycle_tracker = None
         self._fatal_fired = False
         self._fatal_traces: list = []
-        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max(0, max_queue))
+        self._queue: asyncio.Queue = asyncio.Queue(
+            maxsize=0 if self.limiter is not None else max(0, max_queue)
+        )
         self._pump_task: Optional[asyncio.Task] = None
+        self._control_task: Optional[asyncio.Task] = None
         self._in_flight: set[asyncio.Task] = set()
         self._slots: Optional[asyncio.Semaphore] = None
         self._rebuild_lock: Optional[asyncio.Lock] = None
@@ -182,9 +231,35 @@ class MicroBatcher:
             self._slots = asyncio.Semaphore(self.max_in_flight)
             self._rebuild_lock = asyncio.Lock()
             self._pump_task = asyncio.create_task(self._pump())
+            if (
+                self._control_task is None
+                and (self.limiter is not None or self.brownout is not None)
+            ):
+                # idle-path control ticks: the AIMD limit must recover and
+                # the brownout ladder must disarm even with zero traffic
+                # flowing after a storm
+                self._control_task = asyncio.create_task(self._control_loop())
+
+    async def _control_loop(self) -> None:
+        interval = (
+            self.limiter.interval_s if self.limiter is not None else 0.25
+        )
+        while True:
+            await asyncio.sleep(interval)
+            if self.limiter is not None:
+                self.limiter.tick()
+            if self.brownout is not None:
+                self.brownout.evaluate()
 
     async def stop(self) -> None:
         self._closed = True
+        if self._control_task is not None:
+            self._control_task.cancel()
+            try:
+                await self._control_task
+            except asyncio.CancelledError:
+                pass
+            self._control_task = None
         if self._pump_task is not None:
             self._pump_task.cancel()
             try:
@@ -228,6 +303,7 @@ class MicroBatcher:
         image: Image.Image,
         deadline: Optional[Deadline] = None,
         key: Optional[str] = None,
+        cls: Optional[str] = None,
     ) -> list[dict]:
         """One image in, its detections out (awaits the batched device call).
 
@@ -243,6 +319,13 @@ class MicroBatcher:
         deadline expiry cancels only that caller's wait, never the shared
         entry. `key=None` (cache tier disabled) takes the exact pre-cache
         path.
+
+        `cls` ("slo" | "bulk", ISSUE 8; None means slo — the conservative
+        PR 6 default) matters only with the overload-control tier armed:
+        over the adaptive limit, bulk sheds strictly before slo (a queued
+        bulk entry may be revoked — its future fails `AdmitLimitError` —
+        to make room for an slo arrival), and the deepest brownout rung
+        sheds all bulk with `BrownoutShedError` (503).
         """
         metrics = self.engine.metrics
         if self.draining:
@@ -266,7 +349,14 @@ class MicroBatcher:
         if deadline is not None and deadline.expired():
             metrics.record_deadline_exceeded()
             raise deadline.exceeded("queue admission")
+        cls = BULK if cls == BULK else SLO
+        adm = self._admit(cls, metrics)
         fut: asyncio.Future = loop.create_future()
+        if adm is not None:
+            # release the slot whenever the result lands, however it lands
+            # (success, poison, deadline-cancel, drain); idempotent with the
+            # limiter's own revocation release
+            fut.add_done_callback(lambda f, a=adm: a.release())
         if key is not None:
             waiters: list[asyncio.Future] = []
             self._keyed[key] = (fut, waiters)
@@ -290,20 +380,72 @@ class MicroBatcher:
                 deadline if key is None else None,
                 obs.current_trace(),
                 time.monotonic(),
+                adm,
             ))
         except asyncio.QueueFull:
             if key is not None and self._keyed.get(key, (None,))[0] is fut:
                 del self._keyed[key]
+            if adm is not None:  # unreachable (limiter queue is unbounded)
+                adm.release()
             metrics.record_shed()
             raise QueueFullError(
                 f"batch queue full ({self.max_queue} deep)",
-                retry_after_s=max(self.max_delay_s * 2.0, 0.05),
+                retry_after_s=jittered_retry_after(
+                    max(self.max_delay_s * 2.0, 0.05)
+                ),
             ) from None
+        if adm is not None and cls == BULK:
+            # newest-bulk-first revocation target: an over-limit slo arrival
+            # fails this future instead of being shed itself. Once the pump
+            # dispatches the item the admission leaves the revocation stack
+            # (failing it then would waste the engine work already spent).
+            adm.attach_revoke(
+                lambda f=fut: (
+                    None if f.done() else f.set_exception(
+                        AdmitLimitError(
+                            "bulk entry revoked for an slo admission",
+                            retry_after_s=jittered_retry_after(
+                                max(self.max_delay_s * 2.0, 0.05)
+                            ),
+                        )
+                    )
+                )
+            )
         if key is None:
             return await self._await_result(fut, deadline, metrics)
         waiter = loop.create_future()
         waiters.append(waiter)
         return await self._await_result(waiter, deadline, metrics)
+
+    def _admit(self, cls: str, metrics):
+        """Overload-control admission (None when the tier is off — the
+        static queue-depth put_nowait below stays the only gate, exactly
+        the pre-ISSUE-8 semantics)."""
+        if self.brownout is not None:
+            self.brownout.evaluate()
+            if cls == BULK and self.brownout.shed_bulk():
+                metrics.record_shed()
+                metrics.record_admit_shed(BULK)
+                raise BrownoutShedError(
+                    "brownout: bulk traffic shed (rung "
+                    f"{self.brownout.rung})",
+                    retry_after_s=jittered_retry_after(
+                        self.brownout.disarm_s
+                    ),
+                )
+        if self.limiter is None:
+            return None
+        adm = self.limiter.try_admit(cls)
+        if adm is None:
+            metrics.record_shed()
+            raise AdmitLimitError(
+                f"adaptive admission limit hit ({self.limiter.limit} "
+                f"in flight)",
+                retry_after_s=jittered_retry_after(
+                    max(self.max_delay_s * 2.0, 0.05)
+                ),
+            )
+        return adm
 
     async def _await_result(
         self, fut: asyncio.Future, deadline: Optional[Deadline], metrics
@@ -370,7 +512,8 @@ class MicroBatcher:
             batch = [first]
             try:
                 deadline = time.monotonic() + self.max_delay_s
-                while len(batch) < self.max_batch:
+                target = self._dispatch_bucket()
+                while len(batch) < target:
                     timeout = deadline - time.monotonic()
                     if timeout <= 0:
                         break
@@ -394,6 +537,19 @@ class MicroBatcher:
             task = asyncio.create_task(self._run_batch(batch))
             self._in_flight.add(task)
             task.add_done_callback(self._in_flight.discard)
+
+    def _dispatch_bucket(self) -> int:
+        """The pump's fill target: `max_batch`, capped one rung down the
+        engine's bucket ladder while the brownout bucket-cap rung is active
+        (smaller padded dispatches -> fewer wasted pad FLOPs and a shorter
+        per-batch device window under load — the PR 4 bucket-downgrade
+        machinery driven by saturation instead of OOM)."""
+        if self.brownout is None or not self.brownout.bucket_cap_active():
+            return self.max_batch
+        below = [
+            b for b in self.engine.batch_buckets if b < self.max_batch
+        ]
+        return below[-1] if below else self.max_batch
 
     def _detect_outcomes(self, images: list[Image.Image], splits_left: int) -> list:
         """Worker-thread engine call with poison bisect-retry (ISSUE 4).
@@ -444,10 +600,26 @@ class MicroBatcher:
                 await asyncio.sleep(qw_delay)
             t_dispatch = time.monotonic()
             traces = []
+            queue_waits_ms = []
             for item in batch:
+                wait_ms = (t_dispatch - item[4]) * 1000.0
+                queue_waits_ms.append(wait_ms)
+                if self.limiter is not None:
+                    # the AIMD control signal (ISSUE 8): measured queue wait
+                    self.limiter.observe(wait_ms)
+                adm = item[5]
+                if adm is not None:
+                    # dispatched work leaves the revocation stack: failing
+                    # it now would waste the engine slot it already holds
+                    adm.make_unrevocable()
                 if item[3] is not None:
                     item[3].add_span(obs.QUEUE_WAIT, item[4], t_dispatch)
                     traces.append(item[3])
+            # queue_wait joins the /metrics stage histograms (the PR 7
+            # vocabulary) so the limiter's control signal is observable
+            self.engine.metrics.record_stage_samples(
+                obs.QUEUE_WAIT, queue_waits_ms
+            )
             # the engine worker thread inherits this via asyncio.to_thread's
             # context copy and fans its stage windows out to these traces
             obs.set_batch_traces(traces)

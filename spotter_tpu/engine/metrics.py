@@ -87,6 +87,18 @@ class Metrics:
         self._coalesced_submits_total = 0
         self._cache_entries = 0
         self._cache_bytes = 0
+        # Overload-control tier (ISSUE 8): the AIMD limiter's current
+        # limit/in-flight (None limit while the tier is off, so the JSON
+        # view shows "unarmed" rather than a misleading 0), per-class
+        # admission sheds, the brownout ladder's rung gauge + transition
+        # counter, and how many responses were served from expired-TTL
+        # cache entries under the stale rung.
+        self._admit_limit: float | None = None
+        self._admit_in_flight = 0
+        self._admit_sheds_total = {"slo": 0, "bulk": 0}
+        self._brownout_rung = 0
+        self._brownout_transitions_total = 0
+        self._stale_served_total = 0
 
     def record_batch(
         self,
@@ -213,6 +225,55 @@ class Metrics:
         with self._lock:
             self._coalesced_submits_total += n
 
+    def record_stage_samples(self, name: str, values_ms: list[float]) -> None:
+        """Feed per-item samples into a named stage histogram outside
+        `record_batch` (the batcher's queue_wait attribution — ISSUE 8: the
+        AIMD limiter's control signal is the same histogram /metrics
+        shows). One lock hold for the whole batch."""
+        if not values_ms:
+            return
+        with self._lock:
+            ring = self._stages.get(name)
+            if ring is None:
+                ring = self._stages[name] = deque(
+                    maxlen=self._latencies_ms.maxlen
+                )
+            ring.extend(values_ms)
+
+    def set_admit_state(self, limit: int, in_flight: int) -> None:
+        """The AIMD limiter publishes its state on every control tick."""
+        with self._lock:
+            self._admit_limit = limit
+            self._admit_in_flight = in_flight
+
+    def record_admit_shed(self, cls: str, n: int = 1) -> None:
+        """A request shed (or revoked) by the adaptive limiter, by class."""
+        with self._lock:
+            if cls not in self._admit_sheds_total:
+                cls = "slo"
+            self._admit_sheds_total[cls] += n
+
+    def admit_sheds_count(self) -> int:
+        """Cheap all-classes shed count (no full snapshot): the brownout
+        saturation signal polls this — demand that is being SHED is still
+        demand, so the ladder must not read a shed-quiet queue as calm."""
+        with self._lock:
+            return sum(self._admit_sheds_total.values())
+
+    def set_brownout_rung(self, rung: int) -> None:
+        with self._lock:
+            self._brownout_rung = rung
+
+    def record_brownout_transition(self, n: int = 1) -> None:
+        with self._lock:
+            self._brownout_transitions_total += n
+
+    def record_stale_served(self, n: int = 1) -> None:
+        """A response served from an expired-TTL cache entry (brownout
+        stale rung) — the `degraded: stale` marker's counter."""
+        with self._lock:
+            self._stale_served_total += n
+
     def set_cache_size(self, entries: int, nbytes: int) -> None:
         with self._lock:
             self._cache_entries = entries
@@ -302,6 +363,12 @@ class Metrics:
                 "coalesced_submits_total": self._coalesced_submits_total,
                 "cache_entries": self._cache_entries,
                 "cache_bytes": self._cache_bytes,
+                "admit_limit": self._admit_limit,
+                "admit_in_flight": self._admit_in_flight,
+                "admit_sheds_total": dict(self._admit_sheds_total),
+                "brownout_rung": self._brownout_rung,
+                "brownout_transitions_total": self._brownout_transitions_total,
+                "stale_served_total": self._stale_served_total,
                 "shed_total": self._shed_total,
                 "deadline_exceeded_total": self._deadline_exceeded_total,
                 "batch_timeouts_total": self._batch_timeouts_total,

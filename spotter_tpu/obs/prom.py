@@ -29,6 +29,7 @@ _LABELED_KEYS = {
     "time_to_ready_s": ("pool",),
     "requests_total": ("class",),
     "failures_total": ("class",),
+    "admit_sheds_total": ("class",),
 }
 # snapshot keys handled specially (never via the generic walk)
 _SKIP_KEYS = {"latency_ms_histogram", "pools", "dp_degraded"}

@@ -3,6 +3,15 @@
 Field names, nesting, and union shape are a wire contract with the reference
 (apps/spotter/src/spotter/schemas.py:6-32); clients of chilir/spotter must be able
 to talk to this service unchanged.
+
+The one additive extension is `DetectionResponse.degraded` (ISSUE 8): under
+brownout the replica trades quality for survival, and the response says so.
+The field is None — and EXCLUDED from the wire (the serving layers dump with
+`exclude_none=True`) — on every non-degraded response, so existing clients
+see exactly the reference shape; when set it carries the markers that shaped
+this response: "stale" (served from an expired-TTL cache entry),
+"bucket_cap" (dispatch bucket capped), "threshold" (detection threshold
+raised).
 """
 
 from pydantic import BaseModel, HttpUrl
@@ -35,3 +44,6 @@ ImageResult = DetectionSuccessResult | DetectionErrorResult
 class DetectionResponse(BaseModel):
     amenities_description: str
     images: list[ImageResult]
+    # brownout markers (see module docstring); None = not degraded, and the
+    # serving layers drop it from the wire entirely
+    degraded: list[str] | None = None

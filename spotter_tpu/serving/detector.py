@@ -83,6 +83,7 @@ from spotter_tpu.schemas import (
     DetectionSuccessResult,
     ImageResult,
 )
+from spotter_tpu.serving.overload import BULK, BrownoutShedError
 from spotter_tpu.serving.resilience import (
     AdmissionError,
     CircuitBreaker,
@@ -92,6 +93,7 @@ from spotter_tpu.serving.resilience import (
     DrainingError,
     _env_float,
     _env_int,
+    jittered_retry_after,
 )
 from spotter_tpu.ops.preprocess import check_image_pixels
 from spotter_tpu.taxonomy import AMENITIES_MAPPING
@@ -217,13 +219,23 @@ class AmenitiesDetector:
         self._check_fetch_size(url, len(response.content))
         return response.content
 
-    async def _fetch_with_retries(self, url: str) -> bytes:
+    async def _fetch_with_retries(
+        self, url: str, deadline: Deadline | None = None
+    ) -> bytes:
         """3 attempts, exponential backoff in [min, max] s, reraise — the
         reference policy, with or without tenacity installed. Deterministic
         failures (non-408/429 4xx, size-cap rejections) are NOT retried: a
         404 re-fetched 3 times through 22 s of backoff is pure added load
-        and latency with an unchanged outcome."""
-        if _HAVE_TENACITY:
+        and latency with an unchanged outcome.
+
+        Deadline-aware attempts (ISSUE 8 satellite): with a `deadline`,
+        each attempt's timeout is clamped to
+        `min(SPOTTER_TPU_FETCH_TIMEOUT_S, deadline.remaining)` and the
+        retry loop STOPS once the remaining budget cannot cover the
+        backoff plus another attempt — a 15 s per-attempt default must not
+        burn a 200 ms deadline three times over. Deadline-free calls keep
+        the exact reference policy (tenacity when installed)."""
+        if deadline is None and _HAVE_TENACITY:
             image_bytes = None
             retries = AsyncRetrying(
                 stop=stop_after_attempt(FETCH_RETRY_ATTEMPTS),
@@ -240,8 +252,27 @@ class AmenitiesDetector:
                 raise FetchError("failed to fetch image after retries")
             return image_bytes
         for attempt in range(1, FETCH_RETRY_ATTEMPTS + 1):
+            attempt_timeout = self.fetch_timeout_s
+            if deadline is not None:
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    raise deadline.exceeded("image fetch")
+                if attempt_timeout > 0:
+                    attempt_timeout = min(attempt_timeout, remaining)
+                else:
+                    attempt_timeout = remaining
             try:
-                return await self._fetch_image_bytes(url)
+                fetch = self._fetch_image_bytes(url)
+                if attempt_timeout > 0:
+                    try:
+                        return await asyncio.wait_for(fetch, attempt_timeout)
+                    except asyncio.TimeoutError:
+                        raise FetchError(
+                            f"fetch attempt timed out after "
+                            f"{attempt_timeout:.3f} s",
+                            retryable=True,
+                        ) from None
+                return await fetch
             except Exception as exc:
                 if attempt == FETCH_RETRY_ATTEMPTS or not _fetch_retryable(exc):
                     raise
@@ -249,6 +280,11 @@ class AmenitiesDetector:
                     max(float(2**attempt), FETCH_RETRY_WAIT_MIN_S),
                     FETCH_RETRY_WAIT_MAX_S,
                 )
+                if deadline is not None and deadline.remaining() <= wait:
+                    # the budget cannot cover the backoff, let alone the
+                    # attempt after it: skip the pointless retries and
+                    # surface the real failure now
+                    raise
                 await asyncio.sleep(wait)
         raise FetchError("failed to fetch image after retries")  # unreachable
 
@@ -271,7 +307,7 @@ class AmenitiesDetector:
 
     async def _fetch_for_request(self, url: str, deadline: Deadline | None) -> bytes:
         if self.cache is None:  # tier off: the exact pre-cache path
-            fetch = self._fetch_with_retries(url)
+            fetch = self._fetch_with_retries(url, deadline)
             if deadline is not None:
                 return await deadline.wait_for(fetch, "image fetch")
             return await fetch
@@ -286,12 +322,17 @@ class AmenitiesDetector:
         )
 
     async def _process_single_image(
-        self, url: str, deadline: Deadline | None = None
+        self,
+        url: str,
+        deadline: Deadline | None = None,
+        cls: str | None = None,
+        degraded: set[str] | None = None,
     ) -> ImageResult:
         # the ambient request trace (ISSUE 7): span capture below is a
         # monotonic read + list append per stage; None (recorder off, or a
         # bare library call) makes every `with obs.span(...)` a no-op
         trace = obs.current_trace()
+        brownout = self.batcher.brownout
         try:
             with obs.span(obs.FETCH, trace):
                 image_bytes = await self._fetch_for_request(url, deadline)
@@ -309,7 +350,15 @@ class AmenitiesDetector:
                     cached_failure = self.cache.get_negative(cache_key)
                     if cached_failure is not None:
                         raise cached_failure
-                    raw_detections = self.cache.get(cache_key)
+                    # brownout serve-stale rung (ISSUE 8): under sustained
+                    # saturation an expired-TTL entry beats an engine pass —
+                    # the response is marked `degraded: ["stale"]`
+                    raw_detections, was_stale = self.cache.get_entry(
+                        cache_key,
+                        stale_ok=brownout is not None and brownout.stale_ok(),
+                    )
+                    if was_stale and degraded is not None:
+                        degraded.add("stale")
 
                 with Image.open(BytesIO(image_bytes)) as img_raw:
                     # decode-bomb guard: the header-declared pixel count is
@@ -322,8 +371,22 @@ class AmenitiesDetector:
                 # miss: the content hash rides into the batcher for
                 # hash-level coalescing + cache fill on completion
                 raw_detections = await self.batcher.submit(
-                    image, deadline=deadline, key=cache_key
+                    image, deadline=deadline, key=cache_key, cls=cls
                 )
+
+            # brownout threshold rung (ISSUE 8): raise the effective
+            # detection bar so fewer boxes survive into the draw/encode
+            # path (cache entries keep the BASE threshold key — the boost
+            # is a view over them, not a new key space)
+            boost = (
+                brownout.threshold_boost_value() if brownout is not None else 0.0
+            )
+            if boost > 0.0:
+                eff_threshold = min(self._cache_threshold + boost, 0.99)
+                raw_detections = [
+                    d for d in raw_detections
+                    if d.get("score", 1.0) >= eff_threshold
+                ]
 
             with obs.span(obs.POSTPROCESS, trace):
                 draw = ImageDraw.Draw(image)
@@ -378,13 +441,20 @@ class AmenitiesDetector:
             return DetectionErrorResult(url=url, error=f"Processing Error: {e}\n{tb_str}")
 
     async def detect(
-        self, payload: dict, deadline: Deadline | None = None
+        self,
+        payload: dict,
+        deadline: Deadline | None = None,
+        cls: str | None = None,
     ) -> DetectionResponse:
         request = DetectionRequest.model_validate(payload)
         if deadline is None:
             deadline = Deadline.from_env()
         urls = [str(u) for u in request.image_urls]
-        tasks = [self._process_single_image(u, deadline) for u in urls]
+        degraded: set[str] = set()
+        tasks = [
+            self._process_single_image(u, deadline, cls=cls, degraded=degraded)
+            for u in urls
+        ]
         gathered = await asyncio.gather(*tasks, return_exceptions=True)
 
         shed = [r for r in gathered if isinstance(r, AdmissionError)]
@@ -410,13 +480,26 @@ class AmenitiesDetector:
             if amenities
             else "No relevant amenities detected."
         )
-        return DetectionResponse(amenities_description=description, images=results)
+        # the `degraded:` marker contract (ISSUE 8): absent from the wire
+        # unless a brownout concession actually shaped THIS response —
+        # "stale" when any image was served from an expired cache entry,
+        # plus the globally-active rung markers ("bucket_cap", "threshold")
+        brownout = self.batcher.brownout
+        if brownout is not None:
+            degraded.update(brownout.markers())
+        return DetectionResponse(
+            amenities_description=description,
+            images=results,
+            degraded=sorted(degraded) if degraded else None,
+        )
 
-    def check_admission(self) -> AdmissionError | None:
+    def check_admission(self, cls: str | None = None) -> AdmissionError | None:
         """HTTP-layer fast path: an AdmissionError to answer with (mapped to
         429/503 + Retry-After) before any fetch work, or None to proceed.
         Never consumes the breaker's half-open probe slot — a request that
-        could probe must reach `MicroBatcher.submit` to do so."""
+        could probe must reach `MicroBatcher.submit` to do so. `cls`
+        ("slo"|"bulk") lets the deepest brownout rung shed bulk BEFORE the
+        fetch spends bytes on work the batcher would refuse anyway."""
         if self.batcher.draining:
             self.engine.metrics.record_shed()
             return DrainingError("server draining")
@@ -426,6 +509,16 @@ class AmenitiesDetector:
             return CircuitOpenError(
                 "circuit breaker open", retry_after_s=breaker.retry_after_s()
             )
+        brownout = self.batcher.brownout
+        if brownout is not None and cls == BULK:
+            brownout.evaluate()
+            if brownout.shed_bulk():
+                self.engine.metrics.record_shed()
+                self.engine.metrics.record_admit_shed(BULK)
+                return BrownoutShedError(
+                    f"brownout: bulk traffic shed (rung {brownout.rung})",
+                    retry_after_s=jittered_retry_after(brownout.disarm_s),
+                )
         return None
 
     def health(self) -> dict:
@@ -436,13 +529,29 @@ class AmenitiesDetector:
         ready = breaker.state == CircuitBreaker.CLOSED and not draining
         dp = getattr(self.engine, "dp", 1)
         initial_dp = getattr(self.engine, "initial_dp", dp)
+        # brownout state (ISSUE 8): a browned-out replica is READY (it
+        # serves, shedding quality for survival) but /healthz says so —
+        # `status=brownout` outranks the dp-degraded label because it is
+        # the condition an operator can influence (shift load away)
+        brownout = self.batcher.brownout
+        brownout_rung = brownout.evaluate() if brownout is not None else 0
         return {
-            # a degraded replica is still READY (it serves, at reduced
-            # capacity) — "degraded" is the status the fleet alert keys on
             "status": (
-                "ok" if ready and dp >= initial_dp
+                "brownout" if ready and brownout_rung > 0
+                else "ok" if ready and dp >= initial_dp
                 else "degraded" if ready
                 else "unready"
+            ),
+            # overload-control tier state: absent-as-disabled mirrors the
+            # cache block below
+            "brownout": (
+                brownout.snapshot() if brownout is not None
+                else {"enabled": False}
+            ),
+            "admit": (
+                self.batcher.limiter.snapshot()
+                if self.batcher.limiter is not None
+                else {"enabled": False}
             ),
             "ready": ready,
             "breaker": breaker.state,

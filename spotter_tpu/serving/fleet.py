@@ -654,12 +654,19 @@ def retry_after_header(exc: PoolExhaustedError) -> dict[str, str]:
     return {"Retry-After": f"{max(1, round(getattr(exc, 'retry_after_s', 1.0)))}"}
 
 
-def make_fleet_app(controller: FleetController) -> web.Application:
+def make_fleet_app(
+    controller: FleetController, limiter=None
+) -> web.Application:
     """The fleet edge: /detect classifies (header/payload) and routes
     through the controller; /metrics serves the pool gauges the storm bench
-    parses. The controller's tick loop starts/stops with the app."""
+    parses. The controller's tick loop starts/stops with the app.
+    `limiter` (an `overload.AdaptiveLimiter`, default off; armed via
+    `SPOTTER_TPU_ADMIT_EDGE_TARGET_MS` by the entrypoints) is the ISSUE 8
+    AIMD edge gate: adaptive concurrency on observed round-trip latency,
+    shedding bulk before slo when the limit is hit."""
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["fleet"] = controller
+    app["edge_limiter"] = limiter
 
     async def on_startup(app: web.Application) -> None:
         await controller.start()
@@ -687,11 +694,21 @@ def make_fleet_app(controller: FleetController) -> web.Application:
             cls, payload = classify_request(
                 request.headers, payload, default=controller.default_class
             )
+        adm = None
+        if limiter is not None:
+            adm = limiter.try_admit(cls)
+            if adm is None:  # over the adaptive edge limit: bulk sheds first
+                from spotter_tpu.serving.router import edge_shed_response
+
+                return done(edge_shed_response(limiter, cls))
+        # forward the class so replica-level overload control (limiter
+        # class ordering, brownout bulk rung) sees the same verdict
+        headers = obs_http.forward_headers(trace, request_id)
+        headers[REQUEST_CLASS_HEADER] = cls
         t_fwd = time.monotonic()
         try:
             resp = await controller.request(
-                "/detect", payload, cls,
-                headers=obs_http.forward_headers(trace, request_id),
+                "/detect", payload, cls, headers=headers
             )
         except PoolExhaustedError as exc:
             return done(
@@ -701,7 +718,12 @@ def make_fleet_app(controller: FleetController) -> web.Application:
                     headers=retry_after_header(exc),
                 )
             )
-        elapsed_s = time.monotonic() - t_fwd
+        finally:
+            elapsed_s = time.monotonic() - t_fwd
+            if limiter is not None:
+                limiter.observe(elapsed_s * 1000.0)
+            if adm is not None:
+                adm.release()
         with obs.span(obs.ROUTE, trace):
             # replica stages + the transport remainder as a network span:
             # the edge trace tiles against the latency the client saw
@@ -728,8 +750,12 @@ def make_fleet_app(controller: FleetController) -> web.Application:
 
     async def metrics(request: web.Request) -> web.Response:
         # JSON unchanged; Prometheus text exposition of the pool_size /
-        # preemption / replay gauges behind the standard negotiation
-        return obs_http.metrics_response(request, controller.snapshot())
+        # preemption / replay gauges behind the standard negotiation. The
+        # edge limiter's state rides along under "edge_admit" when armed.
+        snap = controller.snapshot()
+        if limiter is not None:
+            snap["edge_admit"] = limiter.snapshot()
+        return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)
     app.router.add_get("/healthz", healthz)
@@ -780,8 +806,14 @@ def main() -> None:
         raise SystemExit("no endpoints: pass --on-demand and/or --spot")
     logging.basicConfig(level=logging.INFO)
     obs_logs.maybe_setup_json_logging()
+    from spotter_tpu.serving.overload import edge_limiter_from_env
+
     controller = static_fleet(on_demand, spot)
-    web.run_app(make_fleet_app(controller), host=args.host, port=args.port)
+    web.run_app(
+        make_fleet_app(controller, limiter=edge_limiter_from_env()),
+        host=args.host,
+        port=args.port,
+    )
 
 
 if __name__ == "__main__":

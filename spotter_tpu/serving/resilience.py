@@ -21,6 +21,7 @@ touched from batch tasks; a lock keeps it safe either way.
 """
 
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -58,6 +59,42 @@ def _env_int(name: str, default: int) -> int:
         return int(raw)
     except ValueError:
         raise ValueError(f"{name} must be an integer, got {raw!r}") from None
+
+
+# Retry-After jitter (ISSUE 8 satellite): deterministic hints synchronize
+# client retry waves — every 429 shed at t0 with "Retry-After: 1" re-arrives
+# as one thundering herd at t0+1. Jittering the hint +-25% (full jitter over
+# the band) decorrelates the waves. Shares the supervisor's
+# SPOTTER_TPU_BACKOFF_JITTER knob (default ON; 0/off/false disables) so one
+# switch governs every backoff-shaped randomness in the system.
+BACKOFF_JITTER_ENV = "SPOTTER_TPU_BACKOFF_JITTER"
+RETRY_AFTER_JITTER_FRAC = 0.25
+_jitter_rng = random.Random()
+
+
+def jitter_enabled_from_env() -> bool:
+    """Default ON: only an explicit 0/off/false disables it."""
+    return os.environ.get(BACKOFF_JITTER_ENV, "1").strip().lower() not in (
+        "0", "off", "false",
+    )
+
+
+def jittered_retry_after(
+    seconds: float,
+    rng: Optional[random.Random] = None,
+    enabled: Optional[bool] = None,
+) -> float:
+    """`seconds` +-25%, uniform over the band; the exact input when the
+    jitter knob is off (or seconds <= 0). `rng` is injectable so tests pin
+    the draw with a seed."""
+    if enabled is None:
+        enabled = jitter_enabled_from_env()
+    if not enabled or seconds <= 0:
+        return seconds
+    r = rng if rng is not None else _jitter_rng
+    return seconds * (
+        1.0 + RETRY_AFTER_JITTER_FRAC * (2.0 * r.random() - 1.0)
+    )
 
 
 class DeadlineExceededError(TimeoutError):
@@ -243,7 +280,13 @@ class CircuitBreaker:
                 self._transition(self.OPEN)
 
     def retry_after_s(self) -> float:
+        # jittered (+-25%, SPOTTER_TPU_BACKOFF_JITTER): a deterministic
+        # cooldown hint re-synchronizes every shed client into one retry
+        # wave exactly when the breaker half-opens — the worst possible
+        # moment for a thundering herd (ISSUE 8 satellite)
         with self._lock:
             if self._state != self.OPEN:
-                return 1.0
-            return max(self.cooldown_s - (self._clock() - self._opened_at), 1.0)
+                return jittered_retry_after(1.0)
+            return jittered_retry_after(
+                max(self.cooldown_s - (self._clock() - self._opened_at), 1.0)
+            )

@@ -36,8 +36,17 @@ from aiohttp import web
 from spotter_tpu import obs
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
-from spotter_tpu.serving.fleet import retry_after_header
+from spotter_tpu.serving.fleet import (
+    REQUEST_CLASS_HEADER,
+    classify_request,
+    retry_after_header,
+)
+from spotter_tpu.serving.overload import (
+    AdaptiveLimiter,
+    edge_limiter_from_env,
+)
 from spotter_tpu.serving.replica_pool import PoolExhaustedError, ReplicaPool
+from spotter_tpu.serving.resilience import jittered_retry_after
 
 logger = logging.getLogger(__name__)
 
@@ -46,9 +55,32 @@ SPOT_REPLICAS_ENV = "SPOTTER_TPU_SPOT_REPLICAS"
 HEDGE_ENV = "SPOTTER_TPU_HEDGE_MS"
 
 
-def make_router_app(pool: ReplicaPool) -> web.Application:
+def edge_shed_response(limiter: AdaptiveLimiter, cls: str) -> web.Response:
+    """429 for an edge-limiter shed: the limit is load state, not failure —
+    clients should retry after the (jittered) hint."""
+    return web.json_response(
+        {
+            "error": f"edge admission limit hit ({limiter.limit} in flight)",
+            "status": 429,
+            "request_class": cls,
+        },
+        status=429,
+        headers={
+            "Retry-After": f"{max(1, round(jittered_retry_after(1.0)))}"
+        },
+    )
+
+
+def make_router_app(
+    pool: ReplicaPool, limiter: AdaptiveLimiter | None = None
+) -> web.Application:
+    """`limiter` (default: `SPOTTER_TPU_ADMIT_EDGE_TARGET_MS` via
+    `edge_limiter_from_env`, None = off) adds the ISSUE 8 AIMD edge gate:
+    concurrency toward the replicas is bounded adaptively on observed
+    round-trip latency, shedding bulk (X-Request-Class) before slo."""
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["pool"] = pool
+    app["edge_limiter"] = limiter
 
     async def on_startup(app: web.Application) -> None:
         await pool.start()
@@ -74,13 +106,19 @@ def make_router_app(pool: ReplicaPool) -> web.Application:
                 payload = await request.json()
             except json.JSONDecodeError:
                 return done(web.Response(status=400, text="Invalid JSON body"))
+            cls, payload = classify_request(request.headers, payload)
+        adm = None
+        if limiter is not None:
+            adm = limiter.try_admit(cls)
+            if adm is None:  # over the adaptive limit: bulk sheds first
+                return done(edge_shed_response(limiter, cls))
+        headers = obs_http.forward_headers(trace, request_id)
+        # the class rides downstream so the replica's limiter/brownout
+        # apply the same bulk-before-slo ordering
+        headers[REQUEST_CLASS_HEADER] = cls
         t_fwd = time.monotonic()
         try:
-            resp = await pool.request(
-                "/detect",
-                payload,
-                headers=obs_http.forward_headers(trace, request_id),
-            )
+            resp = await pool.request("/detect", payload, headers=headers)
         except PoolExhaustedError as exc:
             return done(
                 web.json_response(
@@ -89,7 +127,13 @@ def make_router_app(pool: ReplicaPool) -> web.Application:
                     headers=retry_after_header(exc),
                 )
             )
-        elapsed_s = time.monotonic() - t_fwd
+        finally:
+            elapsed_s = time.monotonic() - t_fwd
+            if limiter is not None:
+                # edge control signal: downstream round-trip latency
+                limiter.observe(elapsed_s * 1000.0)
+            if adm is not None:
+                adm.release()
         with obs.span(obs.ROUTE, trace):
             # replica stages + the transport remainder as a network span:
             # the edge trace tiles against the latency the client saw
@@ -114,8 +158,12 @@ def make_router_app(pool: ReplicaPool) -> web.Application:
 
     async def metrics(request: web.Request) -> web.Response:
         # JSON unchanged; ?format=prometheus / Accept: text/plain for the
-        # text exposition of the same pool gauges (ISSUE 7)
-        return obs_http.metrics_response(request, pool.snapshot())
+        # text exposition of the same pool gauges (ISSUE 7). The edge
+        # limiter's state rides along under "edge_admit" when armed.
+        snap = pool.snapshot()
+        if limiter is not None:
+            snap["edge_admit"] = limiter.snapshot()
+        return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)
     app.router.add_get("/healthz", healthz)
@@ -162,13 +210,21 @@ def main() -> None:
         from spotter_tpu.serving.fleet import make_fleet_app, static_fleet
 
         controller = static_fleet(endpoints, spot_endpoints)
-        web.run_app(make_fleet_app(controller), host=args.host, port=args.port)
+        web.run_app(
+            make_fleet_app(controller, limiter=edge_limiter_from_env()),
+            host=args.host,
+            port=args.port,
+        )
         return
     pool = ReplicaPool(
         endpoints,
         hedge_after_s=args.hedge_ms / 1000.0 if args.hedge_ms > 0 else None,
     )
-    web.run_app(make_router_app(pool), host=args.host, port=args.port)
+    web.run_app(
+        make_router_app(pool, limiter=edge_limiter_from_env()),
+        host=args.host,
+        port=args.port,
+    )
 
 
 if __name__ == "__main__":

@@ -49,6 +49,7 @@ from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.ops import preprocess
 from spotter_tpu.serving import lifecycle
+from spotter_tpu.serving.fleet import classify_request
 from spotter_tpu.serving.resilience import AdmissionError
 from spotter_tpu.testing import faults, stub_engine
 
@@ -203,14 +204,23 @@ def make_app(
         if det is None:  # still loading/warming: shed, probe /startupz
             return done(_not_ready_response(tracker))
         shed = det.check_admission()
-        if shed is not None:  # draining / breaker open: reject before fetching
+        if shed is not None:  # draining / breaker open: reject before parsing
             return done(_shed_response(shed))
         try:
             payload = await request.json()
         except json.JSONDecodeError:
             return done(web.Response(status=400, text="Invalid JSON body"))
+        # request class (ISSUE 8): X-Request-Class header > request_class
+        # payload key (stripped) > deadline tag > env default — the PR 6
+        # fleet precedence, honored at the replica too so the brownout
+        # ladder's bulk-only rung and the limiter's class-ordered shed work
+        # with or without a fleet edge in front
+        cls, payload = classify_request(request.headers, payload)
+        shed = det.check_admission(cls)
+        if shed is not None:  # brownout bulk shed: reject before fetching
+            return done(_shed_response(shed))
         try:
-            response = await det.detect(payload)
+            response = await det.detect(payload, cls=cls)
         except pydantic.ValidationError as exc:
             return done(web.Response(status=400, text=f"Invalid request: {exc}"))
         except AdmissionError as exc:  # every image shed -> 429/503
@@ -218,7 +228,9 @@ def make_app(
         except Exception:
             logger.exception("detect failed")
             return done(web.Response(status=500, text="Internal server error"))
-        return done(web.json_response(response.model_dump()))
+        # exclude_none: the `degraded` marker is on the wire ONLY when a
+        # brownout concession shaped this response (schemas.py contract)
+        return done(web.json_response(response.model_dump(exclude_none=True)))
 
     async def startupz(request: web.Request) -> web.Response:
         """Startup probe: 200 only once the replica reached ready. A long

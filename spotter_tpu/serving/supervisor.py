@@ -50,6 +50,14 @@ import time
 from spotter_tpu.engine.errors import FATAL_ENGINE_EXIT_CODE
 from spotter_tpu.serving.lifecycle import PREEMPTED_EXIT_CODE, RESTARTS_ENV
 
+# The jitter knob moved to serving/resilience.py (ISSUE 8 satellite: the
+# same switch now also governs the +-25% Retry-After jitter on 429/503
+# hints); re-exported here so existing imports keep working.
+from spotter_tpu.serving.resilience import (
+    BACKOFF_JITTER_ENV,  # noqa: F401
+    jitter_enabled_from_env,
+)
+
 logger = logging.getLogger(__name__)
 
 DEFAULT_BACKOFF_BASE_S = 0.5
@@ -59,14 +67,6 @@ DEFAULT_CRASH_LOOP_LIMIT = 5
 DEFAULT_PREEMPT_FAST_LIMIT = 3
 CRASH_LOOP_EXIT_CODE = 84  # distinct from the child's codes and from 83
 
-BACKOFF_JITTER_ENV = "SPOTTER_TPU_BACKOFF_JITTER"
-
-
-def jitter_enabled_from_env() -> bool:
-    """Default ON: only an explicit 0/off/false disables it."""
-    return os.environ.get(BACKOFF_JITTER_ENV, "1").strip().lower() not in (
-        "0", "off", "false",
-    )
 
 
 class Supervisor:

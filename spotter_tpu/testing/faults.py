@@ -54,6 +54,13 @@ preempts N currently-ready spot members at once through their handles
 normal failure mode of spot TPU capacity — a maintenance wave, not an
 independent crash — and the scenario `bench.py --preemption-storm` and the
 fleet chaos tests measure.
+
+The overload tier (ISSUE 8) adds `overload_spike=N`: the adaptive
+admission limiter (serving/overload.py) consumes one per CONTROL TICK via
+`take_overload_spike()` and treats that interval's queue-wait p90 as 10x
+its target — N ticks of synthetic saturation, enough to cut the AIMD limit
+to its floor and (sustained past the arm window) walk the brownout ladder,
+all without generating real queue pressure.
 """
 
 import asyncio
@@ -90,6 +97,11 @@ class FaultPlan:
     # the controller's next tick (consumed whole, not one-by-one — a storm
     # is one correlated event)
     preempt_storm: int = 0
+    # ISSUE 8 overload tier: the AdaptiveLimiter's next N control ticks see
+    # a synthetic far-over-target queue-wait p90 — the deterministic way to
+    # drive the AIMD cut and arm the brownout ladder without generating
+    # real queue pressure (consumed one per control interval)
+    overload_spike: int = 0
     # ISSUE 7 observability tier: "<stage>:<ms>" injects that much latency
     # into the named pipeline stage (obs.STAGES vocabulary: fetch, decode,
     # queue_wait, h2d, device, postprocess, route) on EVERY pass through it
@@ -157,6 +169,7 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "shard_dead",
             "cache_error",
             "preempt_storm",
+            "overload_spike",
             "slow_stage",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
@@ -298,6 +311,18 @@ def take_preempt_storm() -> int:
         n = plan.preempt_storm
         plan.preempt_storm = 0
     return n
+
+
+def take_overload_spike() -> bool:
+    """AdaptiveLimiter hook (serving/overload.py): consume ONE armed
+    overload-spike tick — that control interval evaluates a synthetic
+    far-over-target p90, cutting the limit and (sustained long enough)
+    arming the brownout ladder. `overload_spike=N` arms N consecutive
+    saturated control ticks."""
+    plan = _active
+    if plan is None:
+        return False
+    return plan._consume("overload_spike")
 
 
 def on_shard_probe(device_id: int) -> None:

@@ -5,6 +5,7 @@ import pytest
 
 from spotter_tpu.engine.metrics import Metrics
 from spotter_tpu.serving.resilience import (
+    BACKOFF_JITTER_ENV,
     BREAKER_COOLDOWN_ENV,
     BREAKER_THRESHOLD_ENV,
     DEADLINE_ENV,
@@ -122,10 +123,22 @@ def test_breaker_from_env(monkeypatch):
     assert br.cooldown_s == 2.5
 
 
-def test_breaker_retry_after_tracks_cooldown():
+def test_breaker_retry_after_tracks_cooldown(monkeypatch):
+    # jitter pinned off: this test asserts the exact cooldown arithmetic
+    # (the +-25% jitter contract has its own seeded test in test_overload)
+    monkeypatch.setenv(BACKOFF_JITTER_ENV, "0")
     clock = FakeClock()
     br = CircuitBreaker(threshold=1, cooldown_s=10.0, clock=clock)
     br.record_failure()
     assert br.retry_after_s() == pytest.approx(10.0)
     clock.now += 6.0
     assert br.retry_after_s() == pytest.approx(4.0)
+
+
+def test_breaker_retry_after_jitter_stays_in_band(monkeypatch):
+    monkeypatch.delenv(BACKOFF_JITTER_ENV, raising=False)  # default: on
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=1, cooldown_s=10.0, clock=clock)
+    br.record_failure()
+    for _ in range(50):
+        assert 7.5 <= br.retry_after_s() <= 12.5  # 10 s +- 25%

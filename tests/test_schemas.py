@@ -34,13 +34,31 @@ def test_response_round_trip_mixed_results():
             DetectionErrorResult(url="http://example.com/b.jpg", error="HTTP Error: 404"),
         ],
     )
-    data = resp.model_dump()
+    data = resp.model_dump(exclude_none=True)
     assert data["images"][0]["detections"][0]["label"] == "TV"
     assert data["images"][1]["error"].startswith("HTTP Error:")
-    # Wire shape must be exactly what chilir/spotter clients expect.
+    # Wire shape must be exactly what chilir/spotter clients expect: the
+    # serving layers dump with exclude_none, so a non-degraded response
+    # carries NO `degraded` key (ISSUE 8 marker contract).
     assert set(data.keys()) == {"amenities_description", "images"}
     assert set(data["images"][0].keys()) == {"url", "detections", "labeled_image_base64"}
     assert set(data["images"][1].keys()) == {"url", "error"}
+
+
+def test_response_degraded_marker_contract():
+    """The brownout `degraded` markers are additive: absent unless set,
+    a plain string list when a concession shaped the response."""
+    resp = DetectionResponse(
+        amenities_description="No relevant amenities detected.",
+        images=[],
+        degraded=["bucket_cap", "stale"],
+    )
+    data = resp.model_dump(exclude_none=True)
+    assert data["degraded"] == ["bucket_cap", "stale"]
+    plain = DetectionResponse(
+        amenities_description="No relevant amenities detected.", images=[]
+    )
+    assert "degraded" not in plain.model_dump(exclude_none=True)
 
 
 def test_taxonomy_contract():
