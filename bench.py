@@ -1530,6 +1530,210 @@ def cache_bench(args) -> int:
     return 0
 
 
+def mixed_traffic_bench(args) -> int:
+    """Ragged scheduling, measured not asserted (ISSUE 9): a Zipf-distributed
+    mixed-resolution workload through the REAL MicroBatcher twice — once on
+    the per-bucket FIFO policy (the pre-ISSUE-9 baseline), once with the
+    ragged scheduler armed (deadline-slack ordering + waste-minimizing
+    superbatch packing). The engine is synthetic (CPU ok, model-free): its
+    per-batch service time scales with padded pixels (batch x canvas area),
+    the honest conv-model cost model FLOPs follow — so the goodput delta IS
+    the padded-pixel waste the ragged canvas removes, and nothing else.
+
+    Traffic is two-class (PR 8's vocabulary): an slo fraction carries a
+    deadline, bulk does not. Reports goodput for both policies, the
+    measured padding-waste %% for both, per-class p50/p99, deadline misses,
+    and the slack-at-dispatch summary — all as parsed JSON. Exit 0 requires
+    the acceptance gate: ragged goodput >= 1.25x the FIFO baseline.
+    """
+    import asyncio
+
+    from PIL import Image
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.engine.metrics import Metrics
+    from spotter_tpu.engine.scheduler import Scheduler
+    from spotter_tpu.ops.preprocess import PreprocessSpec
+    from spotter_tpu.serving.overload import BULK, SLO
+    from spotter_tpu.serving.resilience import Deadline, DeadlineExceededError
+
+    max_batch = args.mixed_batch
+    # the DETR serving shape, scaled down 4x so PIL image construction stays
+    # cheap on a CPU box: shortest edge 200, long side <= 333, static bucket
+    # 333x333 — the waste geometry (not the absolute pixel count) is what
+    # the scheduler sees
+    spec = PreprocessSpec(
+        mode="shortest_edge", size=(200, 333), pad_to=(333, 333)
+    )
+    full_area = spec.input_hw[0] * spec.input_hw[1]
+    service_s_full = args.mixed_service_ms / 1000.0  # per batch at full canvas
+
+    class SyntheticEngine:
+        """Service time ~ padded pixels: batch (padded to the bucket) x the
+        staged canvas area. FIFO stages the static bucket; ragged passes the
+        pack's canvas."""
+
+        def __init__(self) -> None:
+            self.metrics = Metrics()
+            self.batch_buckets = (max_batch,)
+            self.calls = 0
+
+        def detect(self, images, canvas_hw=None):
+            self.calls += 1
+            ch, cw = canvas_hw if canvas_hw is not None else spec.input_hw
+            time.sleep(service_s_full * (ch * cw) / full_area)
+            return [[] for _ in images]
+
+    # Zipf resolution mix over a ladder of ASPECT ratios (after the
+    # shortest-edge resize, aspect — not raw pixel count — determines the
+    # valid dims): square thumbnails dominate (the listing-photo shape),
+    # wide/portrait full photos are the tail that needs the whole canvas.
+    # Squares map to (200, 200) = 36% of the static bucket, so the waste
+    # FIFO burns on them is the win ragged packing recovers.
+    ladder = [(160, 160), (240, 240), (200, 300), (300, 200), (250, 333)]
+    ranks = np.arange(1, len(ladder) + 1, dtype=np.float64)
+    weights = ranks ** -args.mixed_zipf
+    weights /= weights.sum()
+    rng = np.random.default_rng(0)
+    shape_idx = rng.choice(len(ladder), size=args.mixed_requests, p=weights)
+    is_slo = rng.random(args.mixed_requests) < args.mixed_slo_fraction
+    # one tiny PIL image per ladder rung (the scheduler only reads dims;
+    # the synthetic engine never touches pixels) — scaled so shortest_edge
+    # resize maps it back onto the rung
+    imgs = {
+        i: Image.fromarray(np.zeros((h, w, 3), np.uint8))
+        for i, (h, w) in enumerate(ladder)
+    }
+
+    def run_phase(ragged: bool):
+        engine = SyntheticEngine()
+        batcher = MicroBatcher(
+            engine,
+            max_batch=max_batch,
+            max_delay_ms=args.mixed_delay_ms,
+            max_in_flight=2,
+            max_queue=0,  # unbounded: the quantity under test is scheduling
+            scheduler=Scheduler(
+                spec=spec, ragged=ragged, step=args.mixed_step
+            ),
+        )
+        lats = {SLO: [], BULK: []}
+        misses = {SLO: 0, BULK: 0}
+        cursor = {"i": 0}
+
+        async def worker() -> None:
+            while cursor["i"] < args.mixed_requests:
+                i = cursor["i"]
+                cursor["i"] += 1
+                cls = SLO if is_slo[i] else BULK
+                deadline = (
+                    Deadline.after(args.mixed_deadline_ms / 1000.0)
+                    if cls == SLO
+                    else None
+                )
+                t0 = time.perf_counter()
+                try:
+                    await batcher.submit(
+                        imgs[shape_idx[i]], deadline=deadline, cls=cls
+                    )
+                    lats[cls].append(time.perf_counter() - t0)
+                except DeadlineExceededError:
+                    misses[cls] += 1
+
+        async def drive():
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(worker() for _ in range(args.mixed_concurrency))
+            )
+            elapsed = time.perf_counter() - t0
+            await batcher.stop()
+            return elapsed
+
+        elapsed = asyncio.run(drive())
+        done = len(lats[SLO]) + len(lats[BULK])
+        snap = engine.metrics.snapshot()
+        return {
+            "goodput_ips": done / elapsed,
+            "completed": done,
+            "deadline_misses": dict(misses),
+            "padding_waste_pct": snap["padding_waste_pct"],
+            "slack_at_dispatch_ms": snap["slack_at_dispatch_ms"],
+            "ragged_packs_total": snap["ragged_packs_total"],
+            "engine_calls": engine.calls,
+            "mean_batch": done / engine.calls if engine.calls else 0.0,
+            "per_class_ms": {
+                cls: {
+                    "p50": round(float(np.median(v)) * 1e3, 2),
+                    "p99": round(float(np.percentile(v, 99)) * 1e3, 2),
+                }
+                for cls, v in lats.items()
+                if v
+            },
+        }
+
+    fifo = run_phase(ragged=False)
+    ragged = run_phase(ragged=True)
+    ratio = (
+        ragged["goodput_ips"] / fifo["goodput_ips"]
+        if fifo["goodput_ips"]
+        else 0.0
+    )
+    dup_note = (
+        f"slo {args.mixed_slo_fraction:.0%} of {args.mixed_requests} reqs, "
+        f"Zipf(s={args.mixed_zipf}) over {len(ladder)} resolutions"
+    )
+    print(
+        f"# mixed-traffic ({dup_note}): FIFO {fifo['goodput_ips']:.1f} img/s "
+        f"(waste {_fmt(fifo['padding_waste_pct'], '.1f')}%) -> ragged "
+        f"{ragged['goodput_ips']:.1f} img/s (waste "
+        f"{_fmt(ragged['padding_waste_pct'], '.1f')}%) = {ratio:.2f}x; "
+        f"slo p99 {_fmt(ragged['per_class_ms'].get(SLO, {}).get('p99'), '.1f')} ms, "
+        f"deadline misses FIFO {sum(fifo['deadline_misses'].values())} -> "
+        f"ragged {sum(ragged['deadline_misses'].values())}",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"ragged-scheduler goodput multiplier vs per-bucket FIFO "
+            f"({dup_note}; padding waste "
+            f"{_fmt(fifo['padding_waste_pct'], '.1f')}%% -> "
+            f"{_fmt(ragged['padding_waste_pct'], '.1f')}%%)"
+        ),
+        "value": round(ratio, 2),
+        "unit": "x_goodput_vs_fifo",
+        "vs_baseline": None,
+        "requests": args.mixed_requests,
+        "slo_fraction": args.mixed_slo_fraction,
+        "zipf_s": args.mixed_zipf,
+        "goodput_fifo_ips": round(fifo["goodput_ips"], 1),
+        "goodput_ragged_ips": round(ragged["goodput_ips"], 1),
+        "goodput_ratio_x": round(ratio, 2),
+        "padding_waste_fifo_pct": (
+            None if fifo["padding_waste_pct"] is None
+            else round(fifo["padding_waste_pct"], 1)
+        ),
+        "padding_waste_ragged_pct": (
+            None if ragged["padding_waste_pct"] is None
+            else round(ragged["padding_waste_pct"], 1)
+        ),
+        "per_class_ms_fifo": fifo["per_class_ms"],
+        "per_class_ms_ragged": ragged["per_class_ms"],
+        "deadline_misses_fifo": fifo["deadline_misses"],
+        "deadline_misses_ragged": ragged["deadline_misses"],
+        "slack_at_dispatch_ms": ragged["slack_at_dispatch_ms"],
+        "ragged_packs_total": ragged["ragged_packs_total"],
+        "engine_calls_fifo": fifo["engine_calls"],
+        "engine_calls_ragged": ragged["engine_calls"],
+        "mean_pack_fifo": round(fifo["mean_batch"], 2),
+        "mean_pack_ragged": round(ragged["mean_batch"], 2),
+    }
+    print(json.dumps(result))
+    # acceptance gate (ISSUE 9): >= 25% goodput gain under the mixed mix
+    if ratio < 1.25:
+        return 1
+    return 0
+
+
 def multichip_serve_bench(args) -> int:
     """dp-sharded REAL serving path, measured not asserted (ISSUE 3): the
     engine (ingest -> H2D -> sharded forward -> fetch) over every local chip
@@ -1692,6 +1896,17 @@ def main() -> int:
         "forced on",
     )
     parser.add_argument(
+        "--int8-dense",
+        default="auto",
+        choices=("auto", "on", "off"),
+        help="int8 attention/FFN matmuls via QuantDense "
+        "(SPOTTER_TPU_INT8_DENSE; ROADMAP item 1, ISSUE 9 satellite). "
+        "'on' also implies --int8 on (dense quantization extends the conv "
+        "int8 mode, never runs alone) and labels the headline row "
+        "+int8dense; 'auto' defers to the env; parity is gated by "
+        "tests/test_quant.py (bf16-vs-int8-dense score/box tolerance)",
+    )
+    parser.add_argument(
         "--dtype",
         default=None,
         help="precision policy (float32|bfloat16|mixed); default SPOTTER_TPU_DTYPE "
@@ -1813,6 +2028,40 @@ def main() -> int:
     parser.add_argument("--cache-fetch-ms", type=float, default=2.0)
     parser.add_argument("--cache-budget-mb", type=float, default=64.0)
     parser.add_argument(
+        "--mixed-traffic",
+        action="store_true",
+        help="run the ragged-scheduling bench instead (CPU ok, model-free): "
+        "a Zipf mixed-resolution two-class workload through the real "
+        "MicroBatcher on the per-bucket FIFO policy vs the ragged "
+        "scheduler; goodput, padding-waste %%, per-class p50/p99 as parsed "
+        "JSON; exits non-zero when the >=1.25x goodput gate fails",
+    )
+    parser.add_argument("--mixed-requests", type=int, default=400)
+    parser.add_argument(
+        "--mixed-concurrency", type=int, default=32,
+        help="closed-loop client concurrency; must exceed in-flight "
+        "capacity (2 x batch) or the ragged lookahead has no queued items "
+        "to choose from",
+    )
+    parser.add_argument(
+        "--mixed-service-ms", type=float, default=40.0,
+        help="synthetic per-batch service time at the FULL static canvas; "
+        "scales with padded pixels (the conv-model cost model)",
+    )
+    parser.add_argument("--mixed-delay-ms", type=float, default=3.0)
+    parser.add_argument("--mixed-deadline-ms", type=float, default=500.0)
+    parser.add_argument(
+        "--mixed-slo-fraction", type=float, default=0.25,
+        help="fraction of requests classed slo (deadline-carrying)",
+    )
+    parser.add_argument("--mixed-zipf", type=float, default=1.1)
+    parser.add_argument("--mixed-batch", type=int, default=8)
+    parser.add_argument(
+        "--mixed-step", type=int, default=64,
+        help="ragged canvas snap step for the bench's scaled-down "
+        "(333x333-bucket) geometry",
+    )
+    parser.add_argument(
         "--trace-overhead",
         action="store_true",
         help="run the tracing-cost bench instead (CPU ok, model-free): p50 "
@@ -1849,6 +2098,8 @@ def main() -> int:
 
     if args.overload:
         return overload_bench(args)
+    if args.mixed_traffic:
+        return mixed_traffic_bench(args)
     if args.overload_storm:
         return overload_storm_bench(args)
     if args.trace_overhead:
@@ -1900,13 +2151,20 @@ def main() -> int:
     # RTDETR_PRESETS isn't imported yet (model imports must follow the env
     # setup); the auto gate keys on the preset naming contract instead.
     rtdetr_like = args.model.startswith("rtdetr")
-    if args.int8 == "on":
+    if args.int8 == "on" or args.int8_dense == "on":
+        # dense is an extension OF the conv int8 mode (utils/quant.py):
+        # --int8-dense on implies the base mode so the row label is truthful
         os.environ[INT8_ENV] = "1"
     elif args.int8 == "off":
         os.environ[INT8_ENV] = "0"
     elif INT8_ENV not in os.environ and on_tpu and rtdetr_like:
         os.environ[INT8_ENV] = "1"
     int8_on = os.environ.get(INT8_ENV, "0") != "0"
+    # explicit --int8-dense wins over the env; auto defers to it
+    if args.int8_dense == "on":
+        os.environ["SPOTTER_TPU_INT8_DENSE"] = "1"
+    elif args.int8_dense == "off":
+        os.environ["SPOTTER_TPU_INT8_DENSE"] = "0"
     # The ViT families (yolos/owlvit) have no ConvNorms — their int8 surface
     # is the QuantDense projections, gated separately
     # (SPOTTER_TPU_INT8_DENSE). `--int8 on` for one of them enables both so
@@ -2178,6 +2436,10 @@ def main() -> int:
         "value": round(best["images_per_sec"], 1),
         "unit": "images/sec",
         "vs_baseline": round(best["images_per_sec"] / args.baseline_per_chip, 3),
+        # quantization config as parsed fields (ISSUE 9 satellite: the
+        # int8-dense row is identifiable without parsing the metric label)
+        "int8": int8_on,
+        "int8_dense": int8_dense_on,
     }
     print(json.dumps(result))
     return 0

@@ -17,4 +17,8 @@ def __getattr__(name: str):
         from spotter_tpu.engine.metrics import Metrics
 
         return Metrics
+    if name in ("Scheduler", "QueueItem", "PackPlan"):
+        from spotter_tpu.engine import scheduler
+
+        return getattr(scheduler, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

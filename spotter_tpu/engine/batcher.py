@@ -46,6 +46,18 @@ fatal/transient engine errors are NEVER cached). Unkeyed submits take the
 exact pre-cache path, so `SPOTTER_TPU_CACHE_MAX_MB=0` keeps serving
 bit-identical to a cache-less build.
 
+Unified scheduler (ISSUE 9): the pump no longer owns its dispatch policy —
+a `Scheduler` (engine/scheduler.py) does. Queue entries are `QueueItem`
+dataclasses (no more positional tuple), dp superbatches are just a bigger
+fill target, keyed coalescing packs to zero items, and the policy is
+swappable: FIFO (default, bit-identical to the pre-ISSUE-9 batcher) or
+ragged (`SPOTTER_TPU_RAGGED=1`) — deadline-slack-ordered admission (slo
+fills the next dispatch first, bulk backfills) and mixed-size images
+packed into one padded superbatch whose canvas minimizes padded-pixel
+waste; the engine stages it over the PR 3 uint8 + `(B, 2)` valid-dims
+substrate. `padding_waste_pct` and `slack_at_dispatch_ms` land in
+/metrics either way so the FIFO baseline is measurable.
+
 Overload control (ISSUE 8, opt-in via `SPOTTER_TPU_ADMIT_TARGET_MS`): the
 static queue-depth shed is replaced by an AIMD adaptive concurrency
 limiter driven by measured queue_wait p90 (the queue becomes unbounded;
@@ -62,6 +74,7 @@ unset both are None and admission is bit-identical to the static build
 """
 
 import asyncio
+import inspect
 import logging
 import time
 from typing import Callable, Optional
@@ -78,6 +91,7 @@ from spotter_tpu.engine.errors import (
     PoisonImageError,
     TransientEngineError,
 )
+from spotter_tpu.engine.scheduler import PackPlan, QueueItem, Scheduler
 from spotter_tpu.serving.overload import (
     BULK,
     SLO,
@@ -135,6 +149,7 @@ class MicroBatcher:
         result_cache=None,
         limiter: Optional[AdaptiveLimiter] = _FROM_ENV,
         brownout: Optional[BrownoutController] = _FROM_ENV,
+        scheduler: Optional[Scheduler] = None,
     ) -> None:
         """`max_queue`/`batch_timeout_ms` default from the env knobs
         (`SPOTTER_TPU_QUEUE_DEPTH`, `SPOTTER_TPU_BATCH_TIMEOUT_MS`);
@@ -149,7 +164,9 @@ class MicroBatcher:
         use, tests) just leaves the breaker to shed. `result_cache`
         (ISSUE 5, a `caching.ResultCache` or None) is filled from keyed
         submits on completion; keyed coalescing itself works with or
-        without it."""
+        without it. `scheduler` (ISSUE 9) is the dispatch policy — default
+        `Scheduler.from_env(engine)`: FIFO unless `SPOTTER_TPU_RAGGED=1`
+        arms slack-ordered ragged packing."""
         self.engine = engine
         self.max_batch = max_batch or engine.batch_buckets[-1]
         # Aggregate bucket sizing (ISSUE 3): under dp-sharded serving the
@@ -191,6 +208,21 @@ class MicroBatcher:
                 brownout = env_brownout
         self.limiter = limiter
         self.brownout = brownout
+        # Unified scheduler (ISSUE 9): the pump's dispatch policy. The
+        # pending buffer lives here (not in the scheduler) so drain()/stop()
+        # account for it; under FIFO it never holds anything between plans.
+        self.scheduler = scheduler or Scheduler.from_env(engine)
+        self._sched_buf: list[QueueItem] = []
+        # Only pass a ragged canvas to engines that accept one: stub and
+        # synthetic engines (tests, benches) may keep the plain
+        # detect(images) signature, and the scheduler still gives them
+        # slack ordering.
+        try:
+            self._engine_takes_canvas = (
+                "canvas_hw" in inspect.signature(engine.detect).parameters
+            )
+        except (TypeError, ValueError):
+            self._engine_takes_canvas = False
         # key -> (primary future, waiter futures): one queue entry per key,
         # its result fanned to every waiter when the primary settles
         self._keyed: dict[str, tuple[asyncio.Future, list[asyncio.Future]]] = {}
@@ -270,11 +302,16 @@ class MicroBatcher:
         # let dispatched batches finish (their futures get real results) …
         if self._in_flight:
             await asyncio.gather(*self._in_flight, return_exceptions=True)
-        # … then fail anything still queued so no submit() caller waits forever
+        # … then fail anything still queued (or held in the scheduler's
+        # pending buffer) so no submit() caller waits forever
         while not self._queue.empty():
-            fut = self._queue.get_nowait()[1]
+            fut = self._queue.get_nowait().fut
             if not fut.done():
                 fut.set_exception(DrainingError("MicroBatcher stopped"))
+        for item in self._sched_buf:
+            if not item.fut.done():
+                item.fut.set_exception(DrainingError("MicroBatcher stopped"))
+        self._sched_buf.clear()
 
     async def drain(self, timeout_s: Optional[float] = None) -> dict:
         """Graceful shutdown (k8s preStop): stop admitting, let the pump flush
@@ -290,7 +327,9 @@ class MicroBatcher:
             not self._queue.empty() or self._pump_busy or self._in_flight
         ) and time.monotonic() < deadline:
             await asyncio.sleep(0.01)
-        leftover = self._queue.qsize()
+        leftover = self._queue.qsize() + sum(
+            1 for it in self._sched_buf if not it.fut.done()
+        )
         await self.stop()
         return {
             "status": "drained" if leftover == 0 else "drain_timeout",
@@ -369,18 +408,20 @@ class MicroBatcher:
                 lambda f, k=key, ws=waiters: self._settle_keyed(k, f, ws)
             )
         try:
-            # keyed entries carry no deadline in the queue tuple: the shared
+            # keyed entries carry no deadline on the item: the shared
             # primary must outlive any single waiter's budget. The ambient
             # request trace (ISSUE 7) rides along so the pump can attribute
             # this item's queue wait and the engine its stage windows; with
             # the flight recorder off it is None and costs nothing.
-            self._queue.put_nowait((
-                image,
-                fut,
-                deadline if key is None else None,
-                obs.current_trace(),
-                time.monotonic(),
-                adm,
+            self._queue.put_nowait(QueueItem(
+                image=image,
+                fut=fut,
+                deadline=deadline if key is None else None,
+                trace=obs.current_trace(),
+                t_submit=time.monotonic(),
+                adm=adm,
+                cls=cls,
+                key=key,
             ))
         except asyncio.QueueFull:
             if key is not None and self._keyed.get(key, (None,))[0] is fut:
@@ -503,38 +544,78 @@ class MicroBatcher:
                     w.set_exception(exc)
 
     async def _pump(self) -> None:
+        buf = self._sched_buf
         while True:
-            self._pump_busy = False
-            first = await self._queue.get()
-            self._pump_busy = True
-            if first[1].done():  # deadline-cancelled while queued
-                continue
-            batch = [first]
+            self._pump_busy = bool(buf)
+            if not buf:
+                first = await self._queue.get()
+                self._pump_busy = True
+                if first.fut.done():  # deadline-cancelled while queued
+                    continue
+                buf.append(first)
             try:
-                deadline = time.monotonic() + self.max_delay_s
                 target = self._dispatch_bucket()
-                while len(batch) < target:
-                    timeout = deadline - time.monotonic()
-                    if timeout <= 0:
-                        break
-                    try:
-                        item = await asyncio.wait_for(self._queue.get(), timeout)
-                    except asyncio.TimeoutError:
-                        break
-                    if not item[1].done():
-                        batch.append(item)
+                gather = self.scheduler.gather_target(target)
+                # top up within one bounded delay window (leftover items
+                # from a prior ragged plan re-enter it — the window, not
+                # arrival order, bounds their extra wait, same as FIFO's
+                # per-batch delay semantics)
+                deadline = time.monotonic() + self.max_delay_s
+                while len(buf) < gather:
+                    if len(buf) >= target:
+                        # past the fill target, the ragged lookahead only
+                        # takes what is already queued — never waits (the
+                        # window exists to fill the bucket, not the choice
+                        # pool)
+                        try:
+                            item = self._queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                    else:
+                        timeout = deadline - time.monotonic()
+                        if timeout <= 0:
+                            break
+                        try:
+                            item = await asyncio.wait_for(
+                                self._queue.get(), timeout
+                            )
+                        except asyncio.TimeoutError:
+                            break
+                    if not item.fut.done():
+                        buf.append(item)
+                # deadline-cancelled (or revoked) while pending: dead weight
+                buf[:] = [it for it in buf if not it.fut.done()]
+                if not buf:
+                    continue
                 await self._slots.acquire()
+                if not self.scheduler.fifo:
+                    # slack ordering's critical window: everything that
+                    # queued while we waited for a slot joins the plan, so
+                    # an slo arrival beats older bulk into THIS dispatch
+                    # (FIFO keeps the pre-ISSUE-9 fixed-batch semantics)
+                    while len(buf) < gather:
+                        try:
+                            item = self._queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        if not item.fut.done():
+                            buf.append(item)
+                plan = self.scheduler.plan(
+                    buf, target,
+                    buckets=getattr(self.engine, "batch_buckets", None),
+                )
             except asyncio.CancelledError:
-                # stop() cancelled us while we hold a drained batch that no
-                # in-flight task owns yet — fail its futures or their
+                # stop() cancelled us while we hold drained items that no
+                # in-flight task owns yet — fail their futures or their
                 # submit() callers would wait forever
-                for item in batch:
-                    if not item[1].done():
-                        item[1].set_exception(
+                for item in buf:
+                    if not item.fut.done():
+                        item.fut.set_exception(
                             DrainingError("MicroBatcher stopped")
                         )
+                buf.clear()
                 raise
-            task = asyncio.create_task(self._run_batch(batch))
+            task = asyncio.create_task(self._run_batch(plan))
             self._in_flight.add(task)
             task.add_done_callback(self._in_flight.discard)
 
@@ -551,7 +632,12 @@ class MicroBatcher:
         ]
         return below[-1] if below else self.max_batch
 
-    def _detect_outcomes(self, images: list[Image.Image], splits_left: int) -> list:
+    def _detect_outcomes(
+        self,
+        images: list[Image.Image],
+        splits_left: int,
+        canvas_hw: Optional[tuple[int, int]] = None,
+    ) -> list:
         """Worker-thread engine call with poison bisect-retry (ISSUE 4).
 
         Returns one outcome per image: a detections list, or the exception
@@ -561,12 +647,17 @@ class MicroBatcher:
         `PoisonImageError` while every innocent neighbor gets its result.
         Typed engine errors (transient after the engine's own retry, fatal)
         are never bisected — they are batch-independent and propagate.
+        `canvas_hw` (ragged, ISSUE 9) rides through the recursion so bisect
+        halves stay in the pack's canvas (same numerics, no recompiles
+        beyond the pack's own shape).
 
         The fault hook runs at every level, exactly where a wedged or
         poisoned device call would fail on a retry too.
         """
         try:
             faults.on_engine_batch(images)
+            if canvas_hw is not None:
+                return list(self.engine.detect(images, canvas_hw=canvas_hw))
             return list(self.engine.detect(images))
         except (FatalEngineError, TransientEngineError):
             raise
@@ -582,16 +673,17 @@ class MicroBatcher:
             self.engine.metrics.record_batch_retry()
             mid = len(images) // 2
             return self._detect_outcomes(
-                images[:mid], splits_left - 1
-            ) + self._detect_outcomes(images[mid:], splits_left - 1)
+                images[:mid], splits_left - 1, canvas_hw
+            ) + self._detect_outcomes(images[mid:], splits_left - 1, canvas_hw)
 
-    async def _run_batch(self, batch) -> None:
+    async def _run_batch(self, plan: PackPlan) -> None:
         try:
             # deadline-cancelled entries waiting for this slot are dead weight
-            batch = [item for item in batch if not item[1].done()]
+            batch = [item for item in plan.items if not item.fut.done()]
             if not batch:
                 return
-            images = [b[0] for b in batch]
+            images = [item.image for item in batch]
+            canvas_hw = plan.canvas_hw if self._engine_takes_canvas else None
             # queue-wait attribution (ISSUE 7): each item's submit -> here.
             # slow_stage=queue_wait:<ms> injects before the dispatch stamp
             # so the injected latency lands inside the queue_wait span.
@@ -601,31 +693,41 @@ class MicroBatcher:
             t_dispatch = time.monotonic()
             traces = []
             queue_waits_ms = []
+            slack_ms = []
             for item in batch:
-                wait_ms = (t_dispatch - item[4]) * 1000.0
+                wait_ms = (t_dispatch - item.t_submit) * 1000.0
                 queue_waits_ms.append(wait_ms)
+                if item.deadline is not None:
+                    # the slack-ordering control signal (ISSUE 9): budget
+                    # left when the scheduler actually dispatched the item
+                    slack_ms.append(item.deadline.remaining() * 1000.0)
                 if self.limiter is not None:
                     # the AIMD control signal (ISSUE 8): measured queue wait
                     self.limiter.observe(wait_ms)
-                adm = item[5]
-                if adm is not None:
+                if item.adm is not None:
                     # dispatched work leaves the revocation stack: failing
                     # it now would waste the engine slot it already holds
-                    adm.make_unrevocable()
-                if item[3] is not None:
-                    item[3].add_span(obs.QUEUE_WAIT, item[4], t_dispatch)
-                    traces.append(item[3])
+                    item.adm.make_unrevocable()
+                if item.trace is not None:
+                    item.trace.add_span(obs.QUEUE_WAIT, item.t_submit, t_dispatch)
+                    traces.append(item.trace)
             # queue_wait joins the /metrics stage histograms (the PR 7
             # vocabulary) so the limiter's control signal is observable
             self.engine.metrics.record_stage_samples(
                 obs.QUEUE_WAIT, queue_waits_ms
+            )
+            self.engine.metrics.record_pack(
+                padding_waste_pct=plan.padding_waste_pct,
+                slack_ms=slack_ms,
+                ragged=canvas_hw is not None,
             )
             # the engine worker thread inherits this via asyncio.to_thread's
             # context copy and fans its stage windows out to these traces
             obs.set_batch_traces(traces)
             try:
                 detect = asyncio.to_thread(
-                    self._detect_outcomes, images, self.poison_max_splits
+                    self._detect_outcomes, images, self.poison_max_splits,
+                    canvas_hw,
                 )
                 if self.batch_timeout_s is not None:
                     outcomes = await asyncio.wait_for(detect, self.batch_timeout_s)
@@ -642,8 +744,8 @@ class MicroBatcher:
                     f"{self.batch_timeout_s:.1f} s (watchdog)"
                 )
                 for item in batch:
-                    if not item[1].done():
-                        item[1].set_exception(exc)
+                    if not item.fut.done():
+                        item.fut.set_exception(exc)
                 return
             except FatalEngineError as exc:
                 await self._handle_fatal(batch, exc)
@@ -653,8 +755,8 @@ class MicroBatcher:
                 self.engine.metrics.record_error(len(batch))
                 self.breaker.record_failure()
                 for item in batch:
-                    if not item[1].done():
-                        item[1].set_exception(exc)
+                    if not item.fut.done():
+                        item.fut.set_exception(exc)
                 return
             self._settle_outcomes(batch, outcomes)
         finally:
@@ -676,7 +778,7 @@ class MicroBatcher:
                 self.engine.metrics.record_poison_isolated(poisons)
                 self.engine.metrics.record_error(len(failed))
         for item, out in zip(batch, outcomes):
-            f, trace = item[1], item[3]
+            f, trace = item.fut, item.trace
             if isinstance(out, BaseException) and trace is not None:
                 # pin the trace even when the future is already settled (a
                 # deadline-expired waiter): the flight recorder's error set
@@ -707,11 +809,11 @@ class MicroBatcher:
         self.breaker.record_failure()
         fatal_traces = []
         for item in batch:
-            if item[3] is not None:
-                item[3].set_error("fatal", str(exc))
-                fatal_traces.append(item[3])
-            if not item[1].done():
-                item[1].set_exception(exc)
+            if item.trace is not None:
+                item.trace.set_error("fatal", str(exc))
+                fatal_traces.append(item.trace)
+            if not item.fut.done():
+                item.fut.set_exception(exc)
         self._fatal_traces = fatal_traces
         gen = getattr(self.engine, "generation", None)
         if getattr(self.engine, "can_degrade", lambda: False)():

@@ -136,6 +136,14 @@ class InferenceEngine:
         self.generation = 0
         self._rebuild_gate = threading.Event()
         self._rebuild_gate.set()
+        # Double-buffered H2D (ISSUE 9): stage-put + dispatch are serialized
+        # by this lock so concurrent batcher worker threads never interleave
+        # their shard uploads, while _finish (the blocking jax.device_get)
+        # runs OUTSIDE it — batch N+1's async _put overlaps batch N's device
+        # step and D2H fetch instead of queueing behind them. The host
+        # decode half stays outside the lock too, so decode keeps its
+        # thread-level parallelism.
+        self._h2d_lock = threading.Lock()
         post_fn = POSTPROCESS_KINDS[built.postprocess]
         k = built.num_top_queries
 
@@ -321,7 +329,11 @@ class InferenceEngine:
             # monolithic device_put that would hit the same dead chip.
             return jax.device_put(arr, self._in_sharding)
 
-    def detect(self, images: list[Image.Image]) -> list[list[dict]]:
+    def detect(
+        self,
+        images: list[Image.Image],
+        canvas_hw: Optional[tuple[int, int]] = None,
+    ) -> list[list[dict]]:
         """PIL images -> per-image lists of {"label", "score", "box"} dicts.
 
         Splits into bucket-sized chunks, pads the tail, strips pad results.
@@ -330,7 +342,18 @@ class InferenceEngine:
         dispatch is async, so chunk N+1's host staging (PIL decode/resize,
         normalize, device_put) and the D2H fetch of chunk N-1 both overlap
         chunk N's device compute instead of serializing with it. Single-chunk
-        calls behave exactly as before (stage -> dispatch -> fetch).
+        calls behave exactly as before (stage -> dispatch -> fetch). Across
+        concurrent detect() calls (the MicroBatcher's worker threads), the
+        H2D lock serializes stage-put + dispatch only — the blocking
+        `jax.device_get` in `_finish` runs outside it, so the next batch's
+        async `_put` overlaps the in-flight batch's device step
+        (double-buffered H2D, ISSUE 9).
+
+        `canvas_hw` (ragged batching, ISSUE 9): a (H, W) padded canvas for
+        shortest_edge specs, smaller than the static bucket, chosen by the
+        scheduler to minimize padded-pixel waste. None (the default, and
+        always for fixed-size specs) stages to the static bucket — the
+        exact pre-ragged program.
 
         Failure classification (ISSUE 4): device exceptions anywhere in the
         stage/dispatch/fetch chain are classified (engine/errors.py). A
@@ -352,30 +375,34 @@ class InferenceEngine:
         pending = None  # (dispatched_item, chunk_images)
         for chunk in chunks:
             try:
-                dispatched = self._dispatch(self._stage(chunk))
+                host = self._stage_host(chunk, canvas_hw)
+                with self._h2d_lock:
+                    dispatched = self._dispatch(self._put_staged(host))
             except Exception as exc:
                 # keep result order: finish the older in-flight chunk first,
                 # then recover (or fail) this one
                 if pending is not None:
-                    results.extend(self._finish_or_recover(*pending))
+                    results.extend(self._finish_or_recover(*pending, canvas_hw))
                     pending = None
-                results.extend(self._recover_chunk(chunk, exc))
+                results.extend(self._recover_chunk(chunk, exc, canvas_hw))
                 continue
             if pending is not None:
-                results.extend(self._finish_or_recover(*pending))
+                results.extend(self._finish_or_recover(*pending, canvas_hw))
             pending = (dispatched, chunk)
         if pending is not None:
-            results.extend(self._finish_or_recover(*pending))
+            results.extend(self._finish_or_recover(*pending, canvas_hw))
         return results
 
-    def _finish_or_recover(self, dispatched_item, images: list[Image.Image]):
+    def _finish_or_recover(
+        self, dispatched_item, images: list[Image.Image], canvas_hw=None
+    ):
         try:
             return self._finish(dispatched_item)
         except Exception as exc:
-            return self._recover_chunk(images, exc)
+            return self._recover_chunk(images, exc, canvas_hw)
 
     def _recover_chunk(
-        self, images: list[Image.Image], exc: Exception
+        self, images: list[Image.Image], exc: Exception, canvas_hw=None
     ) -> list[list[dict]]:
         """Classify a failed chunk and recover when the taxonomy allows it."""
         kind = classify_engine_exception(exc)
@@ -388,40 +415,57 @@ class InferenceEngine:
             self.metrics.record_batch_retry()
             try:
                 if len(images) <= 1:
-                    return self._detect_chunk(images)
+                    return self._detect_chunk(images, canvas_hw)
                 mid = (len(images) + 1) // 2
-                return self._detect_chunk(images[:mid]) + self._detect_chunk(
-                    images[mid:]
-                )
+                return self._detect_chunk(
+                    images[:mid], canvas_hw
+                ) + self._detect_chunk(images[mid:], canvas_hw)
             except Exception as retry_exc:
                 raise as_typed(retry_exc) from retry_exc
         raise exc
 
-    def _detect_chunk(self, images: list[Image.Image]) -> list[list[dict]]:
+    def _detect_chunk(
+        self, images: list[Image.Image], canvas_hw=None
+    ) -> list[list[dict]]:
         """Serial stage -> dispatch -> fetch for one chunk (<= max bucket)."""
-        return self._finish(self._dispatch(self._stage(images)))
+        host = self._stage_host(images, canvas_hw)
+        with self._h2d_lock:
+            dispatched = self._dispatch(self._put_staged(host))
+        return self._finish(dispatched)
 
-    def _stage(self, images: list[Image.Image]):
+    def _stage(self, images: list[Image.Image], canvas_hw=None):
         """Host staging: decode/preprocess, pad to the bucket, device_put.
 
-        Device-preprocess mode stages uint8 pixels + a (B, 2) valid-region
+        Composition of `_stage_host` (decode half, runs outside the H2D
+        lock) and `_put_staged` (upload half) for callers that don't split
+        them.
+        """
+        return self._put_staged(self._stage_host(images, canvas_hw))
+
+    def _stage_host(self, images: list[Image.Image], canvas_hw=None):
+        """Decode/preprocess half of staging: everything before the H2D.
+
+        Device-preprocess mode produces uint8 pixels + a (B, 2) valid-region
         tensor (3 B/px of H2D) instead of float pixels + a full mask
         (16 B/px); either way the per-image host work runs on the decode
-        pool. The decode/h2d split and the transfer bytes are recorded so
-        /metrics and bench.py can show where ingest time goes.
+        pool. `canvas_hw` (ragged, ISSUE 9) shrinks the shortest_edge pad
+        target; pad rows always fill to whatever canvas the real rows got,
+        so one batch is one static shape.
         """
         t0 = time.monotonic()
         faults.sleep_stage(obs.DECODE)  # slow_stage=decode:<ms> injection
         n = len(images)
         bucket = self.bucket_for(n)
         spec = self.built.preprocess_spec
+        if canvas_hw is not None and spec.mode != "shortest_edge":
+            canvas_hw = None  # fixed/pad_square canvases ARE the signal
         if self.device_preprocess:
             pixels, valid, sizes = batch_images_uint8(
-                images, spec, pool=self._decode_pool
+                images, spec, pool=self._decode_pool, canvas_hw=canvas_hw
             )
             if bucket > n:  # pad batch to the static bucket size
                 pad = bucket - n
-                h, w = spec.input_hw
+                h, w = pixels.shape[1:3]
                 pixels = np.concatenate(
                     [pixels, np.zeros((pad, *pixels.shape[1:]), pixels.dtype)]
                 )
@@ -432,7 +476,7 @@ class InferenceEngine:
             host_arrays = (pixels, valid, sizes)
         else:
             pixels, masks, sizes = batch_images_host(
-                images, spec, pool=self._decode_pool
+                images, spec, pool=self._decode_pool, canvas_hw=canvas_hw
             )
             if bucket > n:  # pad batch to the static bucket size
                 pad = bucket - n
@@ -444,7 +488,14 @@ class InferenceEngine:
                 )
                 sizes = np.concatenate([sizes, np.ones((pad, 2), sizes.dtype)])
             host_arrays = (pixels, masks, sizes)
-        t_decode = time.monotonic()
+        return host_arrays, n, t0, time.monotonic()
+
+    def _put_staged(self, host_item):
+        """Upload half of staging: the async `_put`s (per-shard overlap
+        under a mesh) plus the H2D accounting. Callers hold `_h2d_lock`
+        across this + `_dispatch` so uploads stay ordered while `_finish`
+        (D2H) proceeds concurrently."""
+        host_arrays, n, t0, t_decode = host_item
         faults.sleep_stage(obs.H2D)  # slow_stage=h2d:<ms> injection
         staged = tuple(self._put(a) for a in host_arrays)
         self.metrics.record_h2d_bytes(sum(a.nbytes for a in host_arrays), n)

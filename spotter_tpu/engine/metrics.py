@@ -99,6 +99,14 @@ class Metrics:
         self._brownout_rung = 0
         self._brownout_transitions_total = 0
         self._stale_served_total = 0
+        # Ragged scheduling (ISSUE 9): per-dispatch padded-pixel waste (the
+        # quantity ragged packing exists to cut — measured in FIFO mode too,
+        # so the per-bucket baseline is observable), per-item deadline slack
+        # remaining at dispatch (the slack-ordering control signal), and how
+        # many dispatches actually used a ragged canvas.
+        self._padding_waste_pct: deque[float] = deque(maxlen=window)
+        self._slack_at_dispatch_ms: deque[float] = deque(maxlen=window)
+        self._ragged_packs_total = 0
 
     def record_batch(
         self,
@@ -274,6 +282,23 @@ class Metrics:
         with self._lock:
             self._stale_served_total += n
 
+    def record_pack(
+        self,
+        padding_waste_pct: float | None = None,
+        slack_ms: list[float] | None = None,
+        ragged: bool = False,
+    ) -> None:
+        """One scheduler dispatch (ISSUE 9): its padded-pixel waste, the
+        deadline slack each deadline-carrying item had left at dispatch,
+        and whether it staged to a ragged (sub-bucket) canvas."""
+        with self._lock:
+            if padding_waste_pct is not None:
+                self._padding_waste_pct.append(padding_waste_pct)
+            if slack_ms:
+                self._slack_at_dispatch_ms.extend(slack_ms)
+            if ragged:
+                self._ragged_packs_total += 1
+
     def set_cache_size(self, entries: int, nbytes: int) -> None:
         with self._lock:
             self._cache_entries = entries
@@ -332,8 +357,29 @@ class Metrics:
                     [None if le == float("inf") else le, cumulative]
                 )
 
+            # ragged-scheduling stats (ISSUE 9): windowed mean waste + a
+            # slack quantile summary (obs/prom.py renders the dict with
+            # {quantile="..."} labels)
+            waste = (
+                sum(self._padding_waste_pct) / len(self._padding_waste_pct)
+                if self._padding_waste_pct
+                else None
+            )
+            slacks = sorted(self._slack_at_dispatch_ms)
+            slack_summary = (
+                {
+                    tag: slacks[min(int(p * len(slacks)), len(slacks) - 1)]
+                    for p, tag in ((0.50, "p50"), (0.90, "p90"), (0.99, "p99"))
+                }
+                if slacks
+                else None
+            )
+
             return {
                 **stage_stats,
+                "padding_waste_pct": waste,
+                "slack_at_dispatch_ms": slack_summary,
+                "ragged_packs_total": self._ragged_packs_total,
                 "latency_ms_histogram": {
                     "buckets": buckets,
                     "sum": self._latency_sum_ms,

@@ -14,7 +14,9 @@ scraper asks via `Accept: text/plain` or `?format=prometheus`:
   label by `{url="..."}`;
 - the engine snapshot's `latency_ms_histogram` renders as a real
   histogram, with OpenMetrics-style trace-id exemplars on the buckets —
-  the metrics↔traces join the flight recorder exists to serve.
+  the metrics↔traces join the flight recorder exists to serve;
+- quantile-summary dicts (`slack_at_dispatch_ms`, ISSUE 9) render as a
+  Prometheus summary with `{quantile="..."}` labels.
 """
 
 import math
@@ -31,6 +33,13 @@ _LABELED_KEYS = {
     "failures_total": ("class",),
     "admit_sheds_total": ("class",),
 }
+# keys whose dict values are {"p50": x, "p90": y, ...} quantile summaries
+# (the engine snapshot's slack_at_dispatch_ms, ISSUE 9) — rendered as a
+# Prometheus summary with {quantile="0.5"} labels instead of flattened
+# name suffixes
+_SUMMARY_KEYS = {"slack_at_dispatch_ms"}
+_QUANTILE_TAGS = {"p50": "0.5", "p90": "0.9", "p99": "0.99"}
+
 # snapshot keys handled specially (never via the generic walk)
 _SKIP_KEYS = {"latency_ms_histogram", "pools", "dp_degraded"}
 
@@ -100,7 +109,12 @@ def _walk(em: _Emitter, prefix: str, key: str, value) -> None:
         em.add(_name(name, "info"), {"value": value}, 1, "gauge")
     elif isinstance(value, dict):
         labels = _LABELED_KEYS.get(key)
-        if labels is not None:
+        if key in _SUMMARY_KEYS:
+            for tag, v in value.items():
+                q = _QUANTILE_TAGS.get(tag)
+                if q is not None and isinstance(v, (int, float)):
+                    em.add(name, {"quantile": q}, v, "summary")
+        elif labels is not None:
             _walk_labeled(em, name, labels, value, _type_for(key))
         else:
             for k, v in value.items():

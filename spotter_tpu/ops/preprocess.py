@@ -146,13 +146,45 @@ def shortest_edge_size(hw: tuple[int, int], short: int, longest: int) -> tuple[i
     return max(1, min(oh, longest)), max(1, min(ow, longest))
 
 
+def ragged_canvas_supported(spec: PreprocessSpec) -> bool:
+    """Only shortest_edge specs (the DETR family) have a variable valid
+    region inside their static bucket — the slack the ragged scheduler
+    (ISSUE 9) exploits by staging into a smaller padded canvas. fixed /
+    pad_square specs fill their whole canvas with signal."""
+    return spec.mode == "shortest_edge"
+
+
+def _canvas_for(
+    spec: PreprocessSpec,
+    canvas_hw: tuple[int, int] | None,
+    resized_hw: tuple[int, int],
+) -> tuple[int, int]:
+    """Resolve the padded canvas a shortest_edge image stages into: the
+    scheduler's ragged canvas when given (must cover the resize — the
+    scheduler guarantees it; a too-small canvas is a caller bug and fails
+    loudly rather than silently cropping), else the static bucket."""
+    if canvas_hw is None:
+        return spec.input_hw
+    ch, cw = int(canvas_hw[0]), int(canvas_hw[1])
+    rh, rw = resized_hw
+    if rh > ch or rw > cw:
+        raise ValueError(
+            f"ragged canvas {ch}x{cw} cannot hold resized image {rh}x{rw}"
+        )
+    return ch, cw
+
+
 def preprocess_image(
-    image: Image.Image, spec: PreprocessSpec
+    image: Image.Image,
+    spec: PreprocessSpec,
+    canvas_hw: tuple[int, int] | None = None,
 ) -> tuple[np.ndarray, np.ndarray, tuple[int, int]]:
     """PIL image -> (pixels NHWC-sans-N float32, pixel_mask (H, W) float32, orig (h, w)).
 
     pixel_mask is all-ones for fixed mode; for shortest_edge mode it marks valid
-    (non-pad) pixels, the analog of HF DETR's pixel_mask.
+    (non-pad) pixels, the analog of HF DETR's pixel_mask. `canvas_hw`
+    (ragged batching, ISSUE 9) shrinks the shortest_edge pad target below
+    the static bucket; ignored for modes whose canvas IS the signal.
     """
     check_image_pixels(image)
     orig_hw = (image.height, image.width)
@@ -204,7 +236,7 @@ def preprocess_image(
     elif spec.mode == "shortest_edge":
         rh, rw = shortest_edge_size(orig_hw, spec.size[0], spec.size[1])
         resized = image.resize((rw, rh), resample=spec.resample)
-        ph, pw = spec.input_hw
+        ph, pw = _canvas_for(spec, canvas_hw, (rh, rw))
         # Normalize BEFORE padding: pad pixels must be exactly 0 (the torch
         # DETR processor pads after normalization; checkpoints expect 0 pads).
         arr = np.zeros((ph, pw, 3), dtype=np.float32)
@@ -306,13 +338,17 @@ class DecodePool:
 
 
 def decode_resize_uint8(
-    image: Image.Image, spec: PreprocessSpec
+    image: Image.Image,
+    spec: PreprocessSpec,
+    canvas_hw: tuple[int, int] | None = None,
 ) -> tuple[np.ndarray, tuple[int, int], tuple[int, int]]:
     """PIL image -> (uint8 (H, W, 3) in the static bucket, valid (h, w), orig (h, w)).
 
     Host half of the split preprocess: decode + resize only, same resample
     filter and shortest-edge arithmetic as `preprocess_image` (golden parity
     depends on them) — rescale/normalize/mask move to the device.
+    `canvas_hw` (ragged batching, ISSUE 9) shrinks the shortest_edge pad
+    target below the static bucket.
     """
     check_image_pixels(image)
     orig_hw = (image.height, image.width)
@@ -323,7 +359,7 @@ def decode_resize_uint8(
     if spec.mode == "shortest_edge":
         rh, rw = shortest_edge_size(orig_hw, spec.size[0], spec.size[1])
         resized = image.resize((rw, rh), resample=spec.resample)
-        ph, pw = spec.input_hw
+        ph, pw = _canvas_for(spec, canvas_hw, (rh, rw))
         arr = np.zeros((ph, pw, 3), dtype=np.uint8)
         arr[:rh, :rw] = np.asarray(resized, dtype=np.uint8)
         return arr, (rh, rw), orig_hw
@@ -334,10 +370,11 @@ def batch_images_uint8(
     images: list[Image.Image],
     spec: PreprocessSpec,
     pool: DecodePool | None = None,
+    canvas_hw: tuple[int, int] | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Stack uint8-decoded images -> (pixels (B,H,W,3) u8, valid (B,2) i32,
     sizes (B,2) f32 [orig h,w])."""
-    decode = partial(decode_resize_uint8, spec=spec)
+    decode = partial(decode_resize_uint8, spec=spec, canvas_hw=canvas_hw)
     decoded = pool.map(decode, images) if pool is not None else [
         decode(img) for img in images
     ]
@@ -352,10 +389,11 @@ def batch_images_host(
     images: list[Image.Image],
     spec: PreprocessSpec,
     pool: DecodePool | None = None,
+    canvas_hw: tuple[int, int] | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """`batch_images` through the DecodePool: same float output, parallel
     per-image host preprocess (the host path keeps the pool win too)."""
-    process = partial(preprocess_image, spec=spec)
+    process = partial(preprocess_image, spec=spec, canvas_hw=canvas_hw)
     done = pool.map(process, images) if pool is not None else [
         process(img) for img in images
     ]

@@ -561,6 +561,10 @@ class AmenitiesDetector:
             # so a fleet rollout of the new pipeline is auditable per pod
             "dp": dp,
             "device_preprocess": getattr(self.engine, "device_preprocess", False),
+            # ragged scheduling (ISSUE 9): which dispatch policy this
+            # replica runs (FIFO unless SPOTTER_TPU_RAGGED=1), auditable
+            # per pod like the ingest/topology flags above
+            "ragged": self.batcher.scheduler.ragged,
             # engine fault domain (ISSUE 4): lost-shard degradation state
             "dp_degraded": (
                 {"from": initial_dp, "to": dp} if dp < initial_dp else None

@@ -28,6 +28,12 @@ the state-changing admin endpoints (/drain, /profile) require it in the
 `X-Admin-Token` header — without the guard any client could drain a replica
 out of the fleet or trigger a trace capture.
 
+Ragged scheduling (ISSUE 9): `--ragged` (or `SPOTTER_TPU_RAGGED=1`) swaps
+the batcher's per-bucket FIFO for the unified scheduler — deadline-slack
+admission ordering and mixed-resolution superbatch packing; /healthz then
+reports `ragged: true` and /metrics grows `padding_waste_pct` +
+`slack_at_dispatch_ms`. Unset keeps per-bucket semantics bit-identical.
+
 Caching tier (ISSUE 5): `--cache-mb` (or `SPOTTER_TPU_CACHE_MAX_MB`) arms
 the content-addressed result cache + single-flight coalescing tier in the
 detector/batcher; /healthz then reports the cache's size state and /metrics
@@ -371,6 +377,15 @@ def main() -> None:
         help=f"host decode/resize pool size ({preprocess.DECODE_WORKERS_ENV})",
     )
     parser.add_argument(
+        "--ragged",
+        action="store_true",
+        help="ragged mixed-resolution batching + deadline-slack scheduling "
+        "(SPOTTER_TPU_RAGGED=1): mixed-size images pack into one padded "
+        "superbatch chosen to minimize padded-pixel waste, slo traffic "
+        "fills dispatches before bulk; unset keeps per-bucket FIFO "
+        "semantics bit-identical",
+    )
+    parser.add_argument(
         "--cache-mb",
         type=float,
         default=None,
@@ -396,6 +411,10 @@ def main() -> None:
         os.environ["SPOTTER_TPU_SERVE_DP"] = str(args.serve_dp)
     if args.device_preprocess:
         os.environ["SPOTTER_TPU_DEVICE_PREPROCESS"] = "1"
+    if args.ragged:
+        from spotter_tpu.engine.scheduler import RAGGED_ENV
+
+        os.environ[RAGGED_ENV] = "1"
     if args.decode_workers is not None:
         os.environ[preprocess.DECODE_WORKERS_ENV] = str(args.decode_workers)
     if args.cache_mb is not None:
