@@ -1301,6 +1301,117 @@ def trace_overhead_bench(args) -> int:
     return 0 if delta_pct < 1.0 else 1
 
 
+def perf_overhead_bench(args) -> int:
+    """Perf-plane cost proof (ISSUE 10 acceptance): drive the REAL
+    MicroBatcher + stub engine with the device-efficiency plane ON (per-
+    dispatch ledger append, SLO burn-rate bucketing, a fast-polling HBM
+    sampler thread) and OFF (`SPOTTER_TPU_PERF_LEDGER=0`: every record
+    call is a no-op), and report the p50 delta. CPU ok, model-free — the
+    quantity under test is the accounting on the hot path, not the
+    forward pass. Interleaved off/on rounds, same as --trace-overhead.
+
+    Gate: < 1% p50 regression with the plane on. Prints ONE JSON line.
+    """
+    import asyncio
+    import os
+
+    from PIL import Image
+
+    from spotter_tpu import obs
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.obs.perf import PERF_LEDGER_ENV, HbmSampler
+    from spotter_tpu.testing.stub_engine import StubEngine
+
+    service_ms = args.perf_service_ms
+    n_requests = args.perf_requests
+    concurrency = args.perf_concurrency
+    img = Image.fromarray(np.zeros((32, 32, 3), np.uint8))
+
+    def run_pass(enabled: bool) -> list[float]:
+        os.environ[PERF_LEDGER_ENV] = "1" if enabled else "0"
+        engine = StubEngine(service_ms=service_ms)
+        assert engine.metrics.perf.enabled == enabled
+        sampler = None
+        if enabled:
+            # a deliberately aggressive poll (20x the production default)
+            # so the sampler's cost is IN the measured delta, not hidden
+            import jax
+
+            sampler = HbmSampler(
+                jax.local_devices, engine.metrics.perf, interval_s=0.05
+            )
+            sampler.start()
+        batcher = MicroBatcher(engine, max_delay_ms=1.0)
+        lats: list[float] = []
+
+        async def drive():
+            sem = asyncio.Semaphore(concurrency)
+
+            async def one(i: int):
+                async with sem:
+                    t0 = time.perf_counter()
+                    await batcher.submit(img)
+                    lats.append(time.perf_counter() - t0)
+
+            await asyncio.gather(*(one(i) for i in range(n_requests)))
+            await batcher.stop()
+
+        try:
+            asyncio.run(drive())
+        finally:
+            if sampler is not None:
+                sampler.stop()
+        if enabled:
+            snap = engine.metrics.snapshot()
+            # the armed pass must actually have measured something
+            assert snap["device_duty_cycle_pct"] > 0.0
+            assert snap["slo_burn_rate"] == {"fast": 0.0, "slow": 0.0}
+        return lats
+
+    try:
+        # warm both paths once, then interleave off/on rounds so slow
+        # machine drift cancels out of the delta (same protocol as
+        # --trace-overhead)
+        run_pass(False)
+        run_pass(True)
+        off: list[float] = []
+        on: list[float] = []
+        for _ in range(args.perf_rounds):
+            off += run_pass(False)
+            on += run_pass(True)
+    finally:
+        os.environ.pop(PERF_LEDGER_ENV, None)
+    _ = obs  # imported for parity with the trace bench's env hygiene
+    p50_off = float(np.median(off)) * 1e3
+    p50_on = float(np.median(on)) * 1e3
+    delta_pct = (p50_on - p50_off) / p50_off * 100.0 if p50_off else 0.0
+    print(
+        f"# perf-overhead: {len(on)} ledger-on + {len(off)} ledger-off "
+        f"requests (stub service {service_ms:.0f} ms, concurrency "
+        f"{concurrency}, HBM poll 50 ms): p50 off {p50_off:.3f} ms -> on "
+        f"{p50_on:.3f} ms ({delta_pct:+.2f}%)",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"device-efficiency-plane p50 overhead, ledger+HBM sampler+"
+            f"burn-rate on vs off (stub service {service_ms:.0f} ms, "
+            f"{n_requests} req/pass, concurrency {concurrency}; gate < 1%)"
+        ),
+        "value": round(delta_pct, 3),
+        "unit": "percent",
+        "vs_baseline": None,
+        "p50_off_ms": round(p50_off, 3),
+        "p50_on_ms": round(p50_on, 3),
+        "p99_off_ms": round(float(np.percentile(off, 99)) * 1e3, 3),
+        "p99_on_ms": round(float(np.percentile(on, 99)) * 1e3, 3),
+        "gate_pct": 1.0,
+        "pass": bool(delta_pct < 1.0),
+    }
+    print(json.dumps(result))
+    return 0 if delta_pct < 1.0 else 1
+
+
 def cache_bench(args) -> int:
     """Caching tier, measured not asserted (ISSUE 5): the REAL detector +
     MicroBatcher + result-cache/coalescing plumbing under a Zipf-distributed
@@ -2077,6 +2188,22 @@ def main() -> int:
     # against the latency a real engine produces
     parser.add_argument("--trace-service-ms", type=float, default=25.0)
     parser.add_argument(
+        "--perf-overhead",
+        action="store_true",
+        help="run the device-efficiency-plane cost bench instead (CPU ok, "
+        "model-free): p50 delta through the real MicroBatcher with the "
+        "perf ledger + HBM sampler + burn-rate on vs off "
+        "(SPOTTER_TPU_PERF_LEDGER); exits non-zero when the delta breaks "
+        "the < 1%% gate",
+    )
+    parser.add_argument("--perf-requests", type=int, default=400)
+    parser.add_argument("--perf-rounds", type=int, default=3,
+                        help="interleaved off/on measurement rounds")
+    parser.add_argument("--perf-concurrency", type=int, default=8)
+    # 25 ms per batch ~ the measured R101 batch-8 pace (same calibration
+    # as --cache-service-ms / --trace-service-ms)
+    parser.add_argument("--perf-service-ms", type=float, default=25.0)
+    parser.add_argument(
         "--multichip-serve",
         action="store_true",
         help="run the dp-sharded serving bench instead: aggregate img/s over "
@@ -2104,6 +2231,8 @@ def main() -> int:
         return overload_storm_bench(args)
     if args.trace_overhead:
         return trace_overhead_bench(args)
+    if args.perf_overhead:
+        return perf_overhead_bench(args)
     if args.failover:
         return failover_bench(args)
     if args.preemption_storm:
@@ -2428,6 +2557,45 @@ def main() -> int:
         except Exception as exc:
             print(f"# serving-SLO section failed: {exc}", file=sys.stderr)
 
+    # Device-efficiency fields (ISSUE 10): the headline row carries its own
+    # MFU so "did my PR make the chip faster" is judgeable in utilization
+    # terms, not just img/s — flops from XLA's cost analysis on the benched
+    # program, peak from the same env-override/device_kind autodetect the
+    # serving ledger uses. Best-effort: any failure leaves the fields None.
+    mfu_pct = flops_per_image = peak_tflops = None
+    device_kind = getattr(dev, "device_kind", None)
+    try:
+        from spotter_tpu.obs.perf import peak_tflops_for
+
+        peak_tflops = peak_tflops_for(device_kind)
+        if best["batch"] and best["batch"] in per_batch:
+            b = best["batch"]
+            lo = forward.lower(
+                params,
+                jax.ShapeDtypeStruct((b, h, w, 3), np.float32),
+                jax.ShapeDtypeStruct((b, 2), np.float32),
+            )
+            ca = lo.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = float(ca.get("flops", 0.0)) if hasattr(ca, "get") else 0.0
+            if flops > 0:
+                flops_per_image = flops / b
+                if peak_tflops:
+                    amortized_s = per_batch[b]["amortized_ms"] / 1e3
+                    mfu_pct = round(
+                        100.0 * flops / (amortized_s * peak_tflops * 1e12), 2
+                    )
+        print(
+            f"# mfu: {_fmt(mfu_pct, '.2f')}% of {_fmt(peak_tflops, '.0f')} "
+            f"peak TFLOPs ({device_kind}), "
+            f"{_fmt(None if flops_per_image is None else flops_per_image / 1e9, '.2f')} "
+            f"GFLOPs/image",
+            file=sys.stderr,
+        )
+    except Exception as exc:
+        print(f"# mfu fields unavailable: {exc}", file=sys.stderr)
+
     result = {
         "metric": f"{args.model} images/sec/chip ({dev.platform}, "
         f"{policy}{'+int8conv' if int8_on else ''}"
@@ -2440,6 +2608,11 @@ def main() -> int:
         # int8-dense row is identifiable without parsing the metric label)
         "int8": int8_on,
         "int8_dense": int8_dense_on,
+        # device-efficiency fields (ISSUE 10)
+        "device_kind": device_kind,
+        "peak_tflops": peak_tflops,
+        "flops_per_image": flops_per_image,
+        "mfu_pct": mfu_pct,
     }
     print(json.dumps(result))
     return 0

@@ -15,6 +15,7 @@ async request handlers.
 import os
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable, Optional, Sequence
@@ -39,6 +40,7 @@ from spotter_tpu.ops.postprocess import (
     softmax_postprocess,
     to_detections,
 )
+from spotter_tpu.obs.perf import sample_hbm_once
 from spotter_tpu.ops.preprocess import (
     DecodePool,
     PreprocessSpec,
@@ -46,6 +48,7 @@ from spotter_tpu.ops.preprocess import (
     batch_images_uint8,
     device_preprocess_supported,
     device_rescale_normalize,
+    shortest_edge_size,
 )
 
 DEVICE_PREPROCESS_ENV = "SPOTTER_TPU_DEVICE_PREPROCESS"
@@ -144,6 +147,10 @@ class InferenceEngine:
         # decode half stays outside the lock too, so decode keeps its
         # thread-level parallelism.
         self._h2d_lock = threading.Lock()
+        # Compile-provenance thread-local (ISSUE 10): warmup / traffic /
+        # oom_downgrade / rebuild tag every compile-ledger entry with WHY
+        # the program compiled.
+        self._compile_src = threading.local()
         post_fn = POSTPROCESS_KINDS[built.postprocess]
         k = built.num_top_queries
 
@@ -207,6 +214,20 @@ class InferenceEngine:
             self.device = device or jax.devices()[0]
             self.params = jax.device_put(self.built.params, self.device)
             self._in_sharding = self.device
+        # Device-efficiency plane (ISSUE 10): tell the perf ledger what
+        # chips it measures against (peak-TFLOPs autodetect keys on
+        # device_kind) and seed the HBM gauges with one synchronous sample
+        # (None-safe on CPU). Re-run on every re-place so a degraded
+        # rebuild's narrower device set is reflected in the MFU math.
+        try:
+            devs = self.devices()
+            self.metrics.perf.set_device_info(
+                getattr(devs[0], "device_kind", None) if devs else None,
+                len(devs),
+            )
+            sample_hbm_once(self.devices, self.metrics.perf)
+        except Exception:
+            pass
 
     @property
     def dp(self) -> int:
@@ -273,7 +294,8 @@ class InferenceEngine:
 
             mesh = make_mesh(dp=new_dp, tp=1, devices=list(alive_devices)[:new_dp])
             self._place(mesh, None, new_buckets)
-            self.warmup()
+            with self._compile_source("rebuild"):
+                self.warmup()
             # bumped only once the rescaled ladder is compiled and warm:
             # "generation advanced" means "serving again", so the
             # time-to-degraded measurement can't flatter itself
@@ -289,9 +311,52 @@ class InferenceEngine:
                 return b
         return self.batch_buckets[-1]
 
+    @contextmanager
+    def _compile_source(self, source: str):
+        """Tag compiles recorded while the context is active (thread-local:
+        concurrent worker threads never see each other's provenance)."""
+        prev = getattr(self._compile_src, "value", None)
+        self._compile_src.value = source
+        try:
+            yield
+        finally:
+            self._compile_src.value = prev
+
+    def _current_source(self) -> str:
+        return getattr(self._compile_src, "value", None) or "traffic"
+
+    def _shape_key(self, batch: int, h: int, w: int) -> str:
+        return (
+            f"{'u8' if self.device_preprocess else 'f32'}:{batch}x{h}x{w}"
+        )
+
+    def _flops_of(self, abstract_args) -> Optional[float]:
+        """FLOPs of the compiled program for one input shape, from XLA's
+        HLO cost analysis on the lowered (pre-compile) module — a re-trace,
+        not a re-compile, so it is cheap enough to run once per shape
+        inline. Called through `PerfLedger.flops_for`, which caches the
+        result (failures included) per shape key."""
+        lo = self._forward.lower(self.params, *abstract_args)
+        ca = lo.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops") if hasattr(ca, "get") else None
+        return float(flops) if flops else None
+
     def warmup(self) -> None:
-        """Compile every bucket ahead of traffic (first compile is slow)."""
+        """Compile every bucket ahead of traffic (first compile is slow).
+
+        Each bucket's compile lands in the compile ledger (ISSUE 10) with
+        its wall time and provenance (`warmup`, or `rebuild` when called
+        from `rebuild_degraded`), and its program FLOPs are cost-analyzed
+        into the MFU ledger so steady-state traffic never pays the
+        lowering.
+        """
         h, w = self.built.preprocess_spec.input_hw
+        perf = self.metrics.perf
+        source = self._current_source() if getattr(
+            self._compile_src, "value", None
+        ) else "warmup"
         for b in self.batch_buckets:
             # _put with the serving sharding so warmup compiles the exact
             # programs the traffic path will hit (no recompiles later)
@@ -302,7 +367,22 @@ class InferenceEngine:
                 first = self._put(np.zeros((b, h, w, 3), np.float32))
                 second = self._put(np.ones((b, h, w), np.float32))
             sizes = self._put(np.ones((b, 2), np.float32))
+            key = self._shape_key(b, h, w)
+            novel = perf.enabled and perf.compiles.record_dispatch(key)
+            # abstract shapes captured before the call: the uint8 staging
+            # buffer is donated, so the cost-analysis lowering below must
+            # not touch the concrete arrays afterwards
+            absargs = tuple(
+                jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for a in (first, second, sizes)
+            )
+            t_c = time.monotonic()
             jax.block_until_ready(self._forward(self.params, first, second, sizes))
+            if novel:
+                perf.compiles.record_compile(
+                    key, time.monotonic() - t_c, source
+                )
+                perf.flops_for(key, lambda a=absargs: self._flops_of(a))
 
     def _put(self, arr: np.ndarray):
         """Host array -> device(s), per-shard H2D overlap under a mesh.
@@ -414,12 +494,16 @@ class InferenceEngine:
             # HBM-OOM at the top bucket. A second failure propagates typed.
             self.metrics.record_batch_retry()
             try:
-                if len(images) <= 1:
-                    return self._detect_chunk(images, canvas_hw)
-                mid = (len(images) + 1) // 2
-                return self._detect_chunk(
-                    images[:mid], canvas_hw
-                ) + self._detect_chunk(images[mid:], canvas_hw)
+                # compile-ledger provenance (ISSUE 10): the halves may land
+                # in a bucket traffic never compiled — that compile is an
+                # OOM-downgrade cost, not organic traffic churn
+                with self._compile_source("oom_downgrade"):
+                    if len(images) <= 1:
+                        return self._detect_chunk(images, canvas_hw)
+                    mid = (len(images) + 1) // 2
+                    return self._detect_chunk(
+                        images[:mid], canvas_hw
+                    ) + self._detect_chunk(images[mid:], canvas_hw)
             except Exception as retry_exc:
                 raise as_typed(retry_exc) from retry_exc
         raise exc
@@ -488,36 +572,85 @@ class InferenceEngine:
                 )
                 sizes = np.concatenate([sizes, np.ones((pad, 2), sizes.dtype)])
             host_arrays = (pixels, masks, sizes)
-        return host_arrays, n, t0, time.monotonic()
+        return host_arrays, n, t0, time.monotonic(), self._perf_meta(
+            images, pixels, n, spec
+        )
+
+    def _perf_meta(self, images, pixels, n: int, spec) -> Optional[dict]:
+        """Per-dispatch efficiency accounting inputs (ISSUE 10): the shape
+        key the compile ledger tracks, the padded pixel volume the program
+        pays FLOPs for, and the valid pixel volume that carries signal
+        (useful_mfu_pct's discount). None with the ledger off — the
+        disabled path allocates nothing."""
+        if not self.metrics.perf.enabled:
+            return None
+        b, ch, cw = pixels.shape[0], pixels.shape[1], pixels.shape[2]
+        padded_px = b * ch * cw
+        if spec.mode == "shortest_edge":
+            valid_px = 0
+            for im in images:
+                rh, rw = shortest_edge_size(
+                    (int(im.height), int(im.width)), spec.size[0], spec.size[1]
+                )
+                valid_px += min(rh, ch) * min(rw, cw)
+        else:
+            # fixed specs fill the canvas; pad_square approximately does
+            valid_px = n * ch * cw
+        return {
+            "shape": self._shape_key(b, ch, cw),
+            "padded_px": padded_px,
+            "valid_px": min(valid_px, padded_px),
+        }
 
     def _put_staged(self, host_item):
         """Upload half of staging: the async `_put`s (per-shard overlap
         under a mesh) plus the H2D accounting. Callers hold `_h2d_lock`
         across this + `_dispatch` so uploads stay ordered while `_finish`
         (D2H) proceeds concurrently."""
-        host_arrays, n, t0, t_decode = host_item
+        host_arrays, n, t0, t_decode, meta = host_item
         faults.sleep_stage(obs.H2D)  # slow_stage=h2d:<ms> injection
         staged = tuple(self._put(a) for a in host_arrays)
         self.metrics.record_h2d_bytes(sum(a.nbytes for a in host_arrays), n)
         self.metrics.set_decode_queue_depth(self._decode_pool.queue_depth())
-        return staged, n, t0, t_decode, time.monotonic()
+        return staged, n, t0, t_decode, time.monotonic(), meta
 
     def _dispatch(self, staged_item):
-        """Async-dispatch the compiled forward; no host blocking."""
-        staged, n, t0, t_decode, t_pre = staged_item
+        """Async-dispatch the compiled forward; no host blocking (except a
+        novel shape's compile, which the compile ledger times — ISSUE 10)."""
+        staged, n, t0, t_decode, t_pre, meta = staged_item
         # fault seam: a dead-shard or device-OOM injection raises here with
         # the same status markers the real runtime would embed
         faults.on_engine_dispatch(n, [d.id for d in self.devices()])
+        perf = self.metrics.perf
+        novel = meta is not None and perf.compiles.record_dispatch(meta["shape"])
+        if meta is not None:
+            # abstract shapes captured before the call (donation deletes
+            # the staged uint8 buffer once the program runs)
+            absargs = tuple(
+                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in staged
+            )
+        t_c = time.monotonic()
         outputs = self._forward(self.params, *staged)
+        t_disp = time.monotonic()
+        if novel:
+            # first call of a shape blocks on trace+compile; its wall time
+            # IS the serving stall a recompile storm multiplies
+            perf.compiles.record_compile(
+                meta["shape"], t_disp - t_c, self._current_source()
+            )
+        if meta is not None:
+            meta["flops"] = perf.flops_for(
+                meta["shape"], lambda a=absargs: self._flops_of(a)
+            )
         # queue the D2H copies now: they start the moment compute finishes,
         # overlapping the next chunk's staging instead of its fetch
         for arr in outputs:
             arr.copy_to_host_async()
-        return outputs, n, t0, t_decode, t_pre, time.monotonic()
+        return outputs, n, t0, t_decode, t_pre, t_disp, meta
 
     def _finish(self, dispatched_item) -> list[list[dict]]:
         """Block on the fetch, threshold on host, record metrics."""
-        outputs, n, t0, t_decode, t_pre, t_disp = dispatched_item
+        outputs, n, t0, t_decode, t_pre, t_disp, meta = dispatched_item
         faults.sleep_stage(obs.DEVICE)  # slow_stage=device:<ms> injection
         scores, labels, boxes = jax.device_get(outputs)
         t_dev = time.monotonic()
@@ -552,4 +685,19 @@ class InferenceEngine:
                     for name, t_start, t_end in stage_windows},
             trace_id=obs.batch_trace_id(),
         )
+        if meta is not None:
+            # device-efficiency ledger (ISSUE 10): this dispatch's device
+            # window, program FLOPs, and padded/valid pixel split — the
+            # MFU / useful-MFU / duty-cycle inputs. The trace id makes the
+            # top-K expensive-dispatch table joinable against the flight
+            # recorder (/debug/perf -> /debug/traces).
+            self.metrics.perf.record_dispatch(
+                device_s=t_dev - t_disp,
+                batch=n,
+                padded_px=meta.get("padded_px"),
+                valid_px=meta.get("valid_px"),
+                flops=meta.get("flops"),
+                trace_id=obs.batch_trace_id(),
+                shape=meta.get("shape"),
+            )
         return out

@@ -9,6 +9,8 @@ import threading
 import time
 from collections import deque
 
+from spotter_tpu.obs.perf import PerfLedger
+
 # Cumulative-histogram bucket bounds (ms) for batch latency — the
 # Prometheus-exposition view (ISSUE 7) renders these as
 # spotter_tpu_latency_ms_bucket{le="..."} with trace-id exemplars, so a
@@ -107,6 +109,15 @@ class Metrics:
         self._padding_waste_pct: deque[float] = deque(maxlen=window)
         self._slack_at_dispatch_ms: deque[float] = deque(maxlen=window)
         self._ragged_packs_total = 0
+        # Device-efficiency plane (ISSUE 10): MFU/duty-cycle accounting,
+        # compile ledger, HBM gauges, and SLO burn-rate. The ledger is
+        # stdlib-only and owns its own lock; the engine feeds dispatches
+        # and compiles directly (`metrics.perf.record_dispatch(...)`),
+        # while the SLO burn windows are fed from the request-level
+        # counters below (completed images = good, sheds + deadline
+        # misses = bad). `SPOTTER_TPU_PERF_LEDGER=0` makes every perf
+        # record a no-op while keeping the snapshot keys present.
+        self.perf = PerfLedger()
 
     def record_batch(
         self,
@@ -140,6 +151,10 @@ class Metrics:
                         }
                     break
             self._arrivals.append((time.monotonic(), batch_size))
+            # SLO burn (ISSUE 10): completed images are good events (the
+            # enabled gate keeps SPOTTER_TPU_PERF_LEDGER=0 a true no-op)
+            if self.perf.enabled:
+                self.perf.slo.good(batch_size)
             if stages:
                 for name, secs in stages.items():
                     ring = self._stages.get(name)
@@ -157,10 +172,14 @@ class Metrics:
         """A request rejected at admission (queue full / breaker open / drain)."""
         with self._lock:
             self._shed_total += n
+        if self.perf.enabled:  # sheds spend SLO error budget (ISSUE 10)
+            self.perf.slo.bad(n)
 
     def record_deadline_exceeded(self, n: int = 1) -> None:
         with self._lock:
             self._deadline_exceeded_total += n
+        if self.perf.enabled:  # deadline misses spend SLO error budget
+            self.perf.slo.bad(n)
 
     def record_batch_timeout(self, n_images: int) -> None:
         """Watchdog fired on a hung engine call; images count as errors too."""
@@ -321,6 +340,9 @@ class Metrics:
             self._restarts_total = n
 
     def snapshot(self) -> dict:
+        # outside the metrics lock: the perf ledger locks itself, and
+        # nesting the two here would be the only place the order matters
+        perf_snap = self.perf.snapshot()
         with self._lock:
             lats = sorted(self._latencies_ms)
             now = time.monotonic()
@@ -376,6 +398,7 @@ class Metrics:
             )
 
             return {
+                **perf_snap,
                 **stage_stats,
                 "padding_waste_pct": waste,
                 "slack_at_dispatch_ms": slack_summary,

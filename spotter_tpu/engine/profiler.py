@@ -59,6 +59,7 @@ def capture(log_dir: str, duration_s: float = 1.0) -> dict:
         raise RuntimeError("a profiler capture is already running")
     try:
         t0 = time.monotonic()
+        t0_wall = time.time()
         jax.profiler.start_trace(log_dir)
         try:
             time.sleep(duration_s)
@@ -66,9 +67,21 @@ def capture(log_dir: str, duration_s: float = 1.0) -> dict:
             # never leave the process-wide trace running: an orphaned trace
             # would make every later start_trace fail for the process life
             jax.profiler.stop_trace()
+        # flight-recorder join (ISSUE 10 satellite): the trace ids of
+        # requests whose window overlapped the capture, so an xprof trace
+        # can be lined up against /debug/traces request-by-request
+        try:
+            from spotter_tpu.obs import get_recorder
+
+            overlapping = get_recorder().trace_ids_between(
+                t0_wall, time.time()
+            )
+        except Exception:
+            overlapping = []
         return {
             "log_dir": log_dir,
             "duration_s": round(time.monotonic() - t0, 3),
+            "overlapping_trace_ids": overlapping,
         }
     finally:
         _capture_lock.release()

@@ -8,6 +8,19 @@ stay stdlib. This package root re-exports only the stdlib-safe surface;
 HTTP glue is imported explicitly as `spotter_tpu.obs.http`.
 """
 
+from spotter_tpu.obs.perf import (  # noqa: F401
+    HBM_SAMPLE_ENV,
+    PEAK_TFLOPS_ENV,
+    PERF_LEDGER_ENV,
+    SLO_TARGET_PCT_ENV,
+    CompileLedger,
+    HbmSampler,
+    PerfLedger,
+    SloBurn,
+    peak_tflops_for,
+    perf_enabled,
+    sample_hbm_once,
+)
 from spotter_tpu.obs.recorder import (  # noqa: F401
     DUMP_EXIT_CODES,
     TRACE_DUMP_DIR_ENV,

@@ -190,6 +190,34 @@ def make_debug_traces_handler(recorder: FlightRecorder | None = None):
     return debug_traces
 
 
+def make_debug_perf_handler(metrics_getter):
+    """GET /debug/perf (admin-token-gated, like /profile and /debug/traces):
+    the device-efficiency ledger's wide view — top-K most-expensive
+    dispatches (their trace ids join the flight recorder at /debug/traces),
+    the full compile-shape table, per-device HBM, and the SLO burn-rate
+    detail block. `metrics_getter` returns the serving Metrics (or None
+    while the replica is still loading). `?k=<n>` bounds the dispatch table.
+    """
+
+    async def debug_perf(request: web.Request) -> web.Response:
+        rejected = admin_rejection(request)
+        if rejected is not None:
+            return rejected
+        metrics = metrics_getter()
+        if metrics is None:
+            return web.json_response(
+                {"error": "replica starting up", "status": 503}, status=503,
+                headers={"Retry-After": "2"},
+            )
+        try:
+            k = int(request.query.get("k", "0")) or None
+        except ValueError:
+            return web.Response(status=400, text="k must be an integer")
+        return web.json_response(metrics.perf.debug_snapshot(k))
+
+    return debug_perf
+
+
 def metrics_response(request: web.Request, snapshot: dict) -> web.Response:
     """JSON by default (unchanged for existing consumers); Prometheus text
     exposition behind `?format=prometheus` or `Accept: text/plain`."""

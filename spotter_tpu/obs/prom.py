@@ -32,6 +32,10 @@ _LABELED_KEYS = {
     "requests_total": ("class",),
     "failures_total": ("class",),
     "admit_sheds_total": ("class",),
+    # device-efficiency plane (ISSUE 10): burn rate labeled by window
+    # (fast = 1 m, slow = 30 m) and HBM gauges labeled per device
+    "slo_burn_rate": ("window",),
+    "hbm_per_device": ("device", "stat"),
 }
 # keys whose dict values are {"p50": x, "p90": y, ...} quantile summaries
 # (the engine snapshot's slack_at_dispatch_ms, ISSUE 9) — rendered as a
@@ -40,8 +44,11 @@ _LABELED_KEYS = {
 _SUMMARY_KEYS = {"slack_at_dispatch_ms"}
 _QUANTILE_TAGS = {"p50": "0.5", "p90": "0.9", "p99": "0.99"}
 
-# snapshot keys handled specially (never via the generic walk)
-_SKIP_KEYS = {"latency_ms_histogram", "pools", "dp_degraded"}
+# snapshot keys handled specially (never via the generic walk) — plus the
+# compile-shape table (ISSUE 10), which is a per-shape list for /debug/perf
+# and the JSON view; the exposition carries its aggregates
+# (compiles_total / compile_seconds_total / program_cache_hits_total)
+_SKIP_KEYS = {"latency_ms_histogram", "pools", "dp_degraded", "compile_shapes"}
 
 
 def _name(*parts: str) -> str:

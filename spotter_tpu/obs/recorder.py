@@ -117,6 +117,21 @@ class FlightRecorder:
             if t.trace_id == key or t.request_id == key
         ]
 
+    def trace_ids_between(self, t0_wall: float, t1_wall: float) -> list[str]:
+        """Trace ids of recorded traces whose [start, end] wall-clock window
+        overlaps [t0_wall, t1_wall] — the /profile <-> flight-recorder join
+        (ISSUE 10 satellite): an xprof capture summary carries the ids of
+        the requests whose device work landed inside the capture."""
+        out = []
+        for t in self._all():
+            start = getattr(t, "started_at", None)
+            if start is None:
+                continue
+            end = start + (t.duration_ms or 0.0) / 1e3
+            if start <= t1_wall and end >= t0_wall:
+                out.append(t.trace_id)
+        return sorted(set(out))
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

@@ -576,6 +576,10 @@ class AmenitiesDetector:
                 self.cache.stats() if self.cache is not None
                 else {"enabled": False}
             ),
+            # device-efficiency plane (ISSUE 10): fast/slow-window error-
+            # budget burn over deadline misses + sheds — the brownout
+            # ladder's effect shows up here as budget recovery
+            "slo_burn": self.engine.metrics.perf.slo.block(),
         }
 
     async def drain(self) -> dict:

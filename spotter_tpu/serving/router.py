@@ -81,6 +81,12 @@ def make_router_app(
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["pool"] = pool
     app["edge_limiter"] = limiter
+    # Edge SLO burn-rate (ISSUE 10): the device plane's burn windows,
+    # measured at the edge over what CLIENTS saw — sheds (429/503) and
+    # downstream 5xx spend the budget; everything else is good. This is
+    # where "did the brownout ladder actually protect the SLO" is read.
+    slo_burn = obs.SloBurn()
+    app["slo_burn"] = slo_burn
 
     async def on_startup(app: web.Application) -> None:
         await pool.start()
@@ -97,6 +103,10 @@ def make_router_app(
         trace, request_id = obs_http.begin_http_trace(request)
 
         def done(resp: web.Response) -> web.Response:
+            if resp.status in (429, 503) or resp.status >= 500:
+                slo_burn.bad()
+            else:
+                slo_burn.good()
             return obs_http.finish_http_trace(
                 trace, request_id, resp, server_timing=True
             )
@@ -149,7 +159,13 @@ def make_router_app(
         now = time.monotonic()
         available = sum(1 for r in pool.replicas if r.available(now))
         return web.json_response(
-            {"available_replicas": available, "total_replicas": len(pool.replicas)},
+            {
+                "available_replicas": available,
+                "total_replicas": len(pool.replicas),
+                # edge error-budget state (ISSUE 10): same block shape as
+                # the replica's /healthz slo_burn
+                "slo_burn": slo_burn.block(),
+            },
             status=200 if available > 0 else 503,
         )
 
@@ -163,6 +179,10 @@ def make_router_app(
         snap = pool.snapshot()
         if limiter is not None:
             snap["edge_admit"] = limiter.snapshot()
+        # burn-rate gauges ride the pool snapshot additively (ISSUE 10);
+        # prom renders slo_burn_rate{window="fast"|"slow"}
+        snap["slo_target_pct"] = slo_burn.target_pct
+        snap["slo_burn_rate"] = slo_burn.rates()
         return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)

@@ -149,6 +149,20 @@ def make_app(
         det.batcher.attach_lifecycle(tracker)
         if det.batcher.fatal_exit_cb is None:
             det.batcher.fatal_exit_cb = fatal_exit_cb
+        # HBM telemetry (ISSUE 10): poll device.memory_stats() into the
+        # perf ledger's gauges. Only engines with real devices get a
+        # sampler (stub/fake engines have no `.devices`); the thread is a
+        # daemon and is stopped on app cleanup. SPOTTER_TPU_HBM_SAMPLE_S=0
+        # disables it.
+        from spotter_tpu.obs import perf as obs_perf
+
+        devices_fn = getattr(det.engine, "devices", None)
+        if devices_fn is not None and app.get("hbm_sampler") is None:
+            sampler = obs_perf.HbmSampler(
+                devices_fn, det.engine.metrics.perf
+            )
+            if sampler.start():
+                app["hbm_sampler"] = sampler
 
     if detector is not None:
         detector.engine.metrics.set_restarts(lifecycle.restarts_from_env())
@@ -323,6 +337,9 @@ def make_app(
         return web.json_response(summary)
 
     async def on_cleanup(app: web.Application) -> None:
+        sampler = app.get("hbm_sampler")
+        if sampler is not None:
+            sampler.stop()
         task = app.get("bringup_task")
         if task is not None and not task.done():
             task.cancel()
@@ -345,6 +362,19 @@ def make_app(
     app.router.add_post("/profile", profile)
     # flight-recorder view (ISSUE 7): admin-token-gated like /profile
     app.router.add_get("/debug/traces", obs_http.make_debug_traces_handler())
+    # device-efficiency ledger view (ISSUE 10): top-K expensive dispatches
+    # (trace ids join /debug/traces), compile-shape table, HBM, burn-rate —
+    # admin-token-gated like /profile
+    app.router.add_get(
+        "/debug/perf",
+        obs_http.make_debug_perf_handler(
+            lambda: (
+                app["detector"].engine.metrics
+                if app["detector"] is not None
+                else None
+            )
+        ),
+    )
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app

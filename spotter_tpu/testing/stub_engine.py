@@ -88,6 +88,17 @@ class StubEngine:
                     for name, t_start, t_end in stage_windows},
             trace_id=obs.batch_trace_id(),
         )
+        # Device-efficiency ledger (ISSUE 10): the stub's "device" window
+        # is its service sleep; no FLOPs (no compiled program), so MFU
+        # stays 0 while duty-cycle and the top-dispatch table are real —
+        # and `bench.py --perf-overhead` measures the ledger's true cost
+        # on the hot path.
+        self.metrics.perf.record_dispatch(
+            device_s=t_dev - t_h2d,
+            batch=len(images),
+            trace_id=obs.batch_trace_id(),
+            shape=f"stub:{len(images)}",
+        )
         return out
 
 

@@ -343,8 +343,10 @@ def test_recorder_off_path_allocates_no_spans(monkeypatch):
 
 _METRIC_LINE = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*"            # metric name
-    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""  # first label
-    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"  # more labels
+    # label values are quoted strings where backslash escapes (\\, \", \n)
+    # are legal per the text-format spec (ISSUE 10 satellite)
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""  # first label
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"  # more labels
     r" (-?[0-9.e+-]+|\+Inf|-Inf|NaN)"        # value
     r"( # \{trace_id=\"[0-9a-f]+\"\} [0-9.e+-]+ [0-9.e+-]+)?$"  # exemplar
 )
@@ -441,6 +443,65 @@ def test_prometheus_renders_pool_and_fleet_snapshots():
         )
 
     asyncio.run(run())
+
+
+def test_prometheus_label_escaping_per_text_format_spec():
+    """Exposition escaping (ISSUE 10 satellite): label values carrying
+    quotes, backslashes, and newlines (model names, replica URLs) must
+    render per the text-format spec — backslash as \\\\, double quote as
+    \\", newline as \\n — and every emitted line must still parse."""
+    snapshot = {
+        # string leaf -> info-style gauge with a `value` label
+        "breaker_state": 'open "half"\nprobing\\mode',
+        # labeled two-level map (the pool_size shape)
+        "pool_size": {'spot"pool\n\\a': {"ready": 2}},
+        # per-replica list labeled by url
+        "replicas": [
+            {"url": 'http://h/"x"\\path\nend', "requests": 3, "ok": True}
+        ],
+        # burn-rate map: plain labels stay plain
+        "slo_burn_rate": {"fast": 0.5, "slow": 0.25},
+    }
+    text = prom.render(snapshot)
+    lines = _assert_parses(text)
+    assert (
+        'spotter_tpu_breaker_state_info'
+        '{value="open \\"half\\"\\nprobing\\\\mode"} 1'
+    ) in lines
+    assert (
+        'spotter_tpu_pool_size{pool="spot\\"pool\\n\\\\a",state="ready"} 2'
+    ) in lines
+    assert (
+        'spotter_tpu_replicas_requests'
+        '{url="http://h/\\"x\\"\\\\path\\nend"} 3'
+    ) in lines
+    assert 'spotter_tpu_slo_burn_rate{window="fast"} 0.5' in lines
+    # no raw newline may survive inside any sample line (it would split
+    # the exposition mid-sample)
+    for ln in lines:
+        assert "\n" not in ln
+
+
+def test_prometheus_escaping_round_trips_through_a_parser():
+    """The escaped label value must decode back to the original string
+    under the spec's unescaping rules — proof the renderer escapes, not
+    mangles."""
+    ugly = 'a"b\\c\nd'
+    text = prom.render({"model_name": ugly})
+    (line,) = [
+        ln for ln in text.splitlines()
+        if ln.startswith("spotter_tpu_model_name_info")
+    ]
+    start = line.index('value="') + len('value="')
+    end = line.rindex('"}')
+    escaped = line[start:end]
+    decoded = (
+        escaped.replace("\\\\", "\x00")
+        .replace('\\"', '"')
+        .replace("\\n", "\n")
+        .replace("\x00", "\\")
+    )
+    assert decoded == ugly
 
 
 # ---------------------------------------------------------------------------

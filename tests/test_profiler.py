@@ -109,3 +109,50 @@ def test_profile_endpoint(tmp_path, monkeypatch):
             assert "stage_device_ms_p50" in snap
 
     asyncio.run(run())
+
+
+def test_capture_reports_overlapping_trace_ids(tmp_path, monkeypatch):
+    """/profile <-> flight recorder join (ISSUE 10 satellite): a capture's
+    summary carries the trace ids of requests whose window overlapped it,
+    so an xprof trace can be lined up against /debug/traces."""
+    import threading
+    import time
+
+    from spotter_tpu import obs
+
+    monkeypatch.setenv(obs.TRACE_RING_ENV, "64")
+    obs.reset_recorder()
+    recorder = obs.get_recorder()
+    try:
+        summaries = []
+        capture_started = threading.Event()
+
+        def run_capture():
+            capture_started.set()
+            summaries.append(
+                profiler.capture(str(tmp_path / "overlap"), duration_s=0.3)
+            )
+
+        t = threading.Thread(target=run_capture)
+        t.start()
+        capture_started.wait(2.0)
+        # a request that starts AND finishes inside the capture window
+        trace = obs.begin_trace(request_id="req-overlap", enabled=True)
+        time.sleep(0.05)
+        trace.finish()
+        recorder.record(trace)
+        obs.set_current_trace(None)
+        t.join(timeout=10.0)
+        (summary,) = summaries
+        assert trace.trace_id in summary["overlapping_trace_ids"]
+        # a trace recorded long before the window must NOT appear
+        old = obs.begin_trace(request_id="req-old", enabled=True)
+        old.started_at -= 3600.0
+        old.finish()
+        recorder.record(old)
+        obs.set_current_trace(None)
+        now = time.time()
+        ids = recorder.trace_ids_between(now - 0.5, now + 0.5)
+        assert old.trace_id not in ids
+    finally:
+        obs.reset_recorder()

@@ -1,0 +1,155 @@
+"""Bench regression gate (ISSUE 10): diff two BENCH_*.json records.
+
+`python tools/bench_compare.py OLD.json NEW.json [--threshold-pct 5]`
+exits nonzero when NEW regresses OLD past the threshold, so CI (and any
+future PR) has a mechanical "did my change make the chip slower" check
+instead of a human eyeballing the headline number.
+
+Accepted input shapes:
+
+- a bare bench record: `{"metric": ..., "value": ..., "unit": ...,
+  "vs_baseline": ...}` (what `bench.py` prints as its one JSON line);
+- the BENCH_rNN.json wrapper the evidence harness writes, where the
+  record sits under `"parsed"`.
+
+Schema guard (ISSUE 10 satellite): both files are validated — `metric`
+(str), `value` (finite number), `unit` (str) present and typed, and
+`vs_baseline` present (number or null) — and a malformed record fails
+with a readable field-by-field diff (exit 2) instead of silently passing
+the gate. Units must match between the two records for the same reason.
+
+Exit codes: 0 ok (no regression), 1 regression past threshold, 2 schema /
+unit / usage error. Prints ONE JSON line with the comparison as parsed
+fields either way.
+"""
+
+import argparse
+import json
+import math
+import sys
+
+# required keys -> (human type name, validator)
+_SCHEMA = {
+    "metric": ("string", lambda v: isinstance(v, str) and bool(v.strip())),
+    "value": (
+        "finite number",
+        lambda v: isinstance(v, (int, float))
+        and not isinstance(v, bool)
+        and math.isfinite(v),
+    ),
+    "unit": ("string", lambda v: isinstance(v, str) and bool(v.strip())),
+    "vs_baseline": (
+        "finite number or null",
+        lambda v: v is None
+        or (
+            isinstance(v, (int, float))
+            and not isinstance(v, bool)
+            and math.isfinite(v)
+        ),
+    ),
+}
+
+
+def extract_record(payload) -> dict | None:
+    """The bench record itself, unwrapping the BENCH_rNN evidence shape."""
+    if isinstance(payload, dict) and isinstance(payload.get("parsed"), dict):
+        payload = payload["parsed"]
+    return payload if isinstance(payload, dict) else None
+
+
+def validate_record(record, label: str) -> list[str]:
+    """Readable schema-violation lines (empty = valid)."""
+    if not isinstance(record, dict):
+        return [f"{label}: expected a JSON object bench record, got "
+                f"{type(record).__name__}"]
+    problems = []
+    for key, (want, ok) in _SCHEMA.items():
+        if key not in record:
+            problems.append(f"{label}: missing key {key!r} (expected {want})")
+        elif not ok(record[key]):
+            got = record[key]
+            problems.append(
+                f"{label}: key {key!r} expected {want}, got "
+                f"{type(got).__name__} ({got!r})"
+            )
+    return problems
+
+
+def load_record(path: str) -> tuple[dict | None, list[str]]:
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        return None, [f"{path}: unreadable bench record: {exc}"]
+    record = extract_record(payload)
+    return record, validate_record(record, path)
+
+
+def compare(
+    old: dict, new: dict, threshold_pct: float, lower_is_better: bool = False
+) -> dict:
+    """The comparison verdict as parsed fields (no exceptions: callers
+    already validated the records)."""
+    old_v, new_v = float(old["value"]), float(new["value"])
+    delta_pct = (new_v - old_v) / old_v * 100.0 if old_v else 0.0
+    change_pct = -delta_pct if lower_is_better else delta_pct
+    return {
+        "metric_old": old["metric"],
+        "metric_new": new["metric"],
+        "unit": new["unit"],
+        "old_value": old_v,
+        "new_value": new_v,
+        "delta_pct": round(delta_pct, 3),
+        "threshold_pct": threshold_pct,
+        "lower_is_better": lower_is_better,
+        "regression": bool(change_pct < -threshold_pct),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="diff two bench JSON records; exit 1 past the "
+        "regression threshold, 2 on schema errors"
+    )
+    parser.add_argument("old", help="baseline BENCH_*.json (or bare record)")
+    parser.add_argument("new", help="candidate BENCH_*.json (or bare record)")
+    parser.add_argument(
+        "--threshold-pct", type=float, default=5.0,
+        help="regression tolerance in percent (default 5): a candidate "
+        "worse than baseline by more than this fails",
+    )
+    parser.add_argument(
+        "--lower-is-better", action="store_true",
+        help="the metric is a latency/overhead (smaller wins); default "
+        "assumes throughput (bigger wins)",
+    )
+    args = parser.parse_args(argv)
+
+    old, old_problems = load_record(args.old)
+    new, new_problems = load_record(args.new)
+    problems = old_problems + new_problems
+    if not problems and old["unit"] != new["unit"]:
+        problems.append(
+            f"unit mismatch: {args.old} measures {old['unit']!r} but "
+            f"{args.new} measures {new['unit']!r} — not comparable"
+        )
+    if problems:
+        for line in problems:
+            print(f"# bench_compare: {line}", file=sys.stderr)
+        print(json.dumps({"error": "schema", "problems": problems}))
+        return 2
+
+    verdict = compare(old, new, args.threshold_pct, args.lower_is_better)
+    direction = "regression" if verdict["regression"] else "ok"
+    print(
+        f"# bench_compare: {verdict['old_value']} -> {verdict['new_value']} "
+        f"{verdict['unit']} ({verdict['delta_pct']:+.2f}%, threshold "
+        f"{args.threshold_pct:.1f}%) => {direction}",
+        file=sys.stderr,
+    )
+    print(json.dumps(verdict))
+    return 1 if verdict["regression"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
