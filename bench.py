@@ -1413,21 +1413,33 @@ def perf_overhead_bench(args) -> int:
 
 
 def cache_bench(args) -> int:
-    """Caching tier, measured not asserted (ISSUE 5): the REAL detector +
-    MicroBatcher + result-cache/coalescing plumbing under a Zipf-distributed
-    duplicate-heavy URL workload (the shape of listing-photo traffic). The
-    engine is synthetic (fixed per-batch service time — the quantity under
-    test is the cache tier, not the forward pass; CPU ok) and the fetch is a
-    canned in-process client with a configurable latency, so both halves the
-    cache short-circuits are represented.
+    """Caching tier, measured not asserted (ISSUE 5 + ISSUE 11): the REAL
+    detector + MicroBatcher + result-cache/coalescing plumbing under a
+    Zipf-distributed duplicate-heavy URL workload (the shape of
+    listing-photo traffic). The engine is synthetic (fixed per-batch
+    service time — the quantity under test is the cache tier, not the
+    forward pass; CPU ok) and the fetch is a canned in-process client with
+    a configurable latency, so both halves the cache short-circuits are
+    represented.
 
-    Two identical load phases — cache OFF then cache ON — report goodput and
-    the ON/OFF ratio; a sequential measurement phase then pins the hit-path
-    and miss-path p50 exactly (every probe is a known hit / known miss, no
-    concurrency smearing the classification). Prints ONE JSON line with
-    goodput, hit rate, coalesce rate, and hit/miss p50 as parsed fields.
-    Exit 0 requires (at >= 50% duplicates) goodput >= 2x cache-off and
-    hit p50 < 5 ms — the acceptance gate.
+    Two identical load phases — cache OFF then cache ON — report goodput
+    and the ON/OFF ratio; a sequential measurement phase then pins the
+    hit-path and miss-path p50 exactly (every probe is a known hit / known
+    miss, no concurrency smearing the classification), including the
+    annotated-JPEG sidecar's effect on the hit path (ISSUE 11 satellite:
+    plain hits re-decode+draw+encode; annotated hits skip the pillow work).
+
+    Then the ISSUE 11 fleet topology: 4 stub replicas behind the REAL edge
+    router (in-process aiohttp servers, real loopback HTTP), one record,
+    four phases — single-replica reference, random routing (the ~1/N hit
+    decay), affinity routing (rendezvous-hash, JSON), and affinity+frame
+    (binary wire format) — reporting fleet hit rate and bytes-on-wire per
+    request for each.
+
+    Exit 0 requires (at >= 50% duplicates) goodput >= 2x cache-off,
+    hit p50 < 5 ms, annotated hit p50 < plain hit p50, affinity fleet hit
+    rate within 5% of the single-replica rate, and the frame phase cutting
+    bytes-on-wire per request >= 25% vs JSON+base64 — the acceptance gates.
     """
     import asyncio
     from io import BytesIO
@@ -1460,9 +1472,11 @@ def cache_bench(args) -> int:
                 for _ in images
             ]
 
-    def jpeg_for(idx: int) -> bytes:
+    def jpeg_for(idx: int, size: int = 24) -> bytes:
         rng = np.random.default_rng(idx)
-        img = Image.fromarray(rng.integers(0, 255, (24, 24, 3), dtype=np.uint8))
+        img = Image.fromarray(
+            rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+        )
         buf = BytesIO()
         img.save(buf, format="JPEG")
         return buf.getvalue()
@@ -1471,6 +1485,11 @@ def cache_bench(args) -> int:
     # out-of-workload URLs for the exact miss-path probes
     probes = {f"http://cdn/probe-{i}.jpg": jpeg_for(10_000 + i) for i in range(10)}
     bodies.update(probes)
+    # a listing-photo-sized probe for the annotated-sidecar comparison: on
+    # a 24x24 image the pillow work the sidecar skips is noise; on a real
+    # photo it is most of the hit path (PR 5's ~3.3 ms hit p50)
+    BIG_PROBE = "http://cdn/probe-big.jpg"
+    bodies[BIG_PROBE] = jpeg_for(20_000, size=320)
 
     class CannedClient:
         def __init__(self) -> None:
@@ -1555,6 +1574,106 @@ def cache_bench(args) -> int:
             misses.append(time.perf_counter() - t0)
         return float(np.median(hits)) * 1e3, float(np.median(misses)) * 1e3
 
+    async def annotated_probe_phase(det) -> tuple[float, float]:
+        """Hit-path p50 with and without the annotated-JPEG sidecar
+        (ISSUE 11 satellite), on a listing-photo-sized probe. Plain first
+        (sidecar attach disabled — every hit re-decodes, re-draws and
+        re-encodes), then with the sidecar attached."""
+
+        async def timed_hits(n: int = 20) -> float:
+            samples = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                await det.detect({"image_urls": [BIG_PROBE]})
+                samples.append(time.perf_counter() - t0)
+            return float(np.median(samples)) * 1e3
+
+        det.cache.annotated = False
+        await det.detect({"image_urls": [BIG_PROBE]})  # fill (plain entry)
+        plain_p50_ms = await timed_hits()
+        det.cache.annotated = True
+        await det.detect({"image_urls": [BIG_PROBE]})  # hit; attaches sidecar
+        annotated_p50_ms = await timed_hits()
+        return plain_p50_ms, annotated_p50_ms
+
+    async def fleet_phase(
+        n_replicas: int, affinity: bool, frame: bool
+    ) -> dict:
+        """One ISSUE 11 topology phase: n stub replicas (REAL standalone
+        app, synthetic engine, per-replica result cache) behind the REAL
+        edge router, driven over loopback HTTP with the Zipf workload."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from spotter_tpu.serving import wire as wire_mod
+        from spotter_tpu.serving.replica_pool import ReplicaPool
+        from spotter_tpu.serving.router import make_router_app
+        from spotter_tpu.serving.standalone import make_app
+
+        dets, servers, urls = [], [], []
+        for _ in range(n_replicas):
+            det, _engine = build(with_cache=True)
+            server = TestServer(make_app(detector=det))
+            await server.start_server()
+            dets.append(det)
+            servers.append(server)
+            urls.append(f"http://{server.host}:{server.port}")
+        pool = ReplicaPool(urls, health_interval_s=0.25)
+        router_app = make_router_app(pool, affinity=affinity)
+        headers = (
+            {"Accept": wire_mod.FRAME_CONTENT_TYPE} if frame else {}
+        )
+        cursor = {"i": 0}
+        async with TestClient(TestServer(router_app)) as client:
+
+            async def worker() -> None:
+                while cursor["i"] < n_requests:
+                    i = cursor["i"]
+                    cursor["i"] += 1
+                    resp = await client.post(
+                        "/detect",
+                        json={"image_urls": [workload[i]]},
+                        headers=headers,
+                    )
+                    await resp.read()
+                    assert resp.status == 200, f"HTTP {resp.status}"
+
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(worker() for _ in range(args.cache_concurrency))
+            )
+            elapsed = time.perf_counter() - t0
+            router_snap = json.loads(
+                await (await client.get("/metrics")).read()
+            )
+        hits = misses = 0
+        for det in dets:
+            snap = det.engine.metrics.snapshot()
+            hits += snap["cache_hits_total"]
+            misses += snap["cache_misses_total"]
+        for server in servers:
+            await server.close()
+        for det in dets:
+            await det.aclose()
+        lookups = hits + misses
+        w = router_snap["wire"]
+        return {
+            "replicas": n_replicas,
+            "affinity": affinity,
+            "frame": frame,
+            "goodput_ips": round(n_requests / elapsed, 1),
+            "fleet_hit_rate": round(hits / lookups, 3) if lookups else 0.0,
+            "affinity_hit_rate": round(
+                router_snap["affinity"]["hit_rate"], 3
+            ),
+            "wire_bytes_out_per_request": round(
+                w["bytes_out_per_request"], 1
+            ),
+            "wire_bytes_out_total": w["bytes_out_total"],
+            "edge_negative_hits_total": router_snap["edge_negative"][
+                "hits_total"
+            ],
+        }
+
     async def drive():
         det_off, eng_off = build(with_cache=False)
         off_elapsed, off_lats = await load_phase(det_off)
@@ -1563,10 +1682,23 @@ def cache_bench(args) -> int:
         det_on, eng_on = build(with_cache=True)
         on_elapsed, on_lats = await load_phase(det_on)
         hit_p50_ms, miss_p50_ms = await probe_phase(det_on)
+        plain_hit_p50_ms, annotated_hit_p50_ms = await annotated_probe_phase(
+            det_on
+        )
         snap = eng_on.metrics.snapshot()
         cache_stats = det_on.cache.stats()
         fetches_on = det_on.client.fetches
         await det_on.aclose()
+
+        # ISSUE 11 fleet topology: single-replica reference, random-routing
+        # decay, affinity recovery, and the binary-frame bytes cut — one
+        # record, attributable phase by phase
+        fleet = {
+            "single": await fleet_phase(1, affinity=False, frame=False),
+            "random": await fleet_phase(4, affinity=False, frame=False),
+            "affinity": await fleet_phase(4, affinity=True, frame=False),
+            "affinity_frame": await fleet_phase(4, affinity=True, frame=True),
+        }
         return {
             "off": (off_elapsed, off_lats, det_off.client.fetches, eng_off.calls),
             "on": (on_elapsed, on_lats, fetches_on, eng_on.calls),
@@ -1574,6 +1706,9 @@ def cache_bench(args) -> int:
             "cache_stats": cache_stats,
             "hit_p50_ms": hit_p50_ms,
             "miss_p50_ms": miss_p50_ms,
+            "plain_hit_p50_ms": plain_hit_p50_ms,
+            "annotated_hit_p50_ms": annotated_hit_p50_ms,
+            "fleet": fleet,
         }
 
     out = asyncio.run(drive())
@@ -1587,6 +1722,15 @@ def cache_bench(args) -> int:
     hit_rate = snap["cache_hits_total"] / lookups if lookups else 0.0
     coalesce_rate = snap["coalesced_submits_total"] / n_requests
     hit_p50_ms, miss_p50_ms = out["hit_p50_ms"], out["miss_p50_ms"]
+    fleet = out["fleet"]
+    single_rate = fleet["single"]["fleet_hit_rate"]
+    random_rate = fleet["random"]["fleet_hit_rate"]
+    affinity_rate = fleet["affinity"]["fleet_hit_rate"]
+    json_bpr = fleet["affinity"]["wire_bytes_out_per_request"]
+    frame_bpr = fleet["affinity_frame"]["wire_bytes_out_per_request"]
+    wire_cut_pct = (
+        (1.0 - frame_bpr / json_bpr) * 100.0 if json_bpr else 0.0
+    )
     print(
         f"# cache: {n_requests} requests over {n_unique} Zipf(s="
         f"{args.cache_zipf}) URLs ({duplicate_fraction:.0%} duplicates), "
@@ -1596,7 +1740,18 @@ def cache_bench(args) -> int:
         f"{goodput_on:.1f} img/s ({on_fetches} fetches, {on_calls} engine "
         f"calls) = {ratio:.2f}x; hit rate {hit_rate:.0%}, coalesce rate "
         f"{coalesce_rate:.0%}; hit p50 {hit_p50_ms:.2f} ms vs miss p50 "
-        f"{miss_p50_ms:.2f} ms",
+        f"{miss_p50_ms:.2f} ms; annotated hit p50 "
+        f"{out['annotated_hit_p50_ms']:.2f} ms vs plain "
+        f"{out['plain_hit_p50_ms']:.2f} ms",
+        file=sys.stderr,
+    )
+    print(
+        f"# fleet (ISSUE 11): single-replica hit rate {single_rate:.0%} -> "
+        f"random@4 {random_rate:.0%} (the ~1/N decay) -> affinity@4 "
+        f"{affinity_rate:.0%} (owner-hit rate "
+        f"{fleet['affinity']['affinity_hit_rate']:.0%}); bytes/request "
+        f"JSON {json_bpr:.0f} -> frame {frame_bpr:.0f} = "
+        f"{wire_cut_pct:.1f}% cut",
         file=sys.stderr,
     )
     result = {
@@ -1620,6 +1775,8 @@ def cache_bench(args) -> int:
         "load_p50_on_ms": round(float(np.median(on_lats)) * 1e3, 2),
         "hit_p50_ms": round(hit_p50_ms, 3),
         "miss_p50_ms": round(miss_p50_ms, 3),
+        "plain_hit_p50_ms": round(out["plain_hit_p50_ms"], 3),
+        "annotated_hit_p50_ms": round(out["annotated_hit_p50_ms"], 3),
         "hit_rate": round(hit_rate, 3),
         "coalesce_rate": round(coalesce_rate, 3),
         "cache_hits_total": snap["cache_hits_total"],
@@ -1633,12 +1790,41 @@ def cache_bench(args) -> int:
         "fetches_cache_on": on_fetches,
         "engine_calls_cache_off": off_calls,
         "engine_calls_cache_on": on_calls,
+        # ISSUE 11 fleet topology phases, one record for attribution
+        "fleet": fleet,
+        "fleet_hit_rate_single": single_rate,
+        "fleet_hit_rate_random": random_rate,
+        "fleet_hit_rate_affinity": affinity_rate,
+        "wire_bytes_per_request_json": json_bpr,
+        "wire_bytes_per_request_frame": frame_bpr,
+        "wire_bytes_cut_pct": round(wire_cut_pct, 1),
     }
     print(json.dumps(result))
-    # acceptance gate: at >= 50% duplicates the tier must pay for itself
-    if duplicate_fraction >= 0.5 and (ratio < 2.0 or hit_p50_ms >= 5.0):
-        return 1
-    return 0
+    # acceptance gates: at >= 50% duplicates the tier must pay for itself
+    # (ISSUE 5), the annotated sidecar must beat the plain hit path, the
+    # affinity fleet must hold the single-replica hit rate within 5%, and
+    # the frame must cut bytes/request >= 25% (ISSUE 11)
+    failures = []
+    if duplicate_fraction >= 0.5:
+        if ratio < 2.0:
+            failures.append(f"goodput ratio {ratio:.2f} < 2.0")
+        if hit_p50_ms >= 5.0:
+            failures.append(f"hit p50 {hit_p50_ms:.2f} ms >= 5 ms")
+        if affinity_rate < 0.95 * single_rate:
+            failures.append(
+                f"affinity fleet hit rate {affinity_rate:.3f} < 95% of "
+                f"single-replica {single_rate:.3f}"
+            )
+    if out["annotated_hit_p50_ms"] >= out["plain_hit_p50_ms"]:
+        failures.append(
+            f"annotated hit p50 {out['annotated_hit_p50_ms']:.2f} ms did "
+            f"not beat plain {out['plain_hit_p50_ms']:.2f} ms"
+        )
+    if wire_cut_pct < 25.0:
+        failures.append(f"frame cut {wire_cut_pct:.1f}% < 25%")
+    for failure in failures:
+        print(f"# GATE FAIL: {failure}", file=sys.stderr)
+    return 1 if failures else 0
 
 
 def mixed_traffic_bench(args) -> int:

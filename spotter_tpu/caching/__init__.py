@@ -29,6 +29,13 @@ _EXPORTS = {
     "CACHE_MAX_MB_ENV": "spotter_tpu.caching.result_cache",
     "CACHE_TTL_ENV": "spotter_tpu.caching.result_cache",
     "CACHE_NEGATIVE_TTL_ENV": "spotter_tpu.caching.result_cache",
+    "CACHE_ANNOTATED_ENV": "spotter_tpu.caching.result_cache",
+    # the ONE key-normalization module (ISSUE 11): edge affinity keys and
+    # replica cache keys both come from here so they can never drift
+    "content_key": "spotter_tpu.caching.keys",
+    "url_key": "spotter_tpu.caching.keys",
+    "affinity_key": "spotter_tpu.caching.keys",
+    "normalize_url": "spotter_tpu.caching.keys",
 }
 
 __all__ = list(_EXPORTS)

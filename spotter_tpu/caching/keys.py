@@ -1,0 +1,56 @@
+"""The ONE key-normalization module for the data plane (ISSUE 11).
+
+Three layers hash the same identifiers and must never drift:
+
+- the replica's content-addressed result cache keys detections on
+  `(model, sha256(bytes), threshold bucket)` and its negative cache keys
+  deterministic fetch failures on the URL (`url|<url>`);
+- the edge router's rendezvous ring hashes the URL to pick the replica
+  whose cache already holds that URL's result;
+- the edge's negative verdict table is keyed by the same URL string the
+  replica used when it recorded the verdict.
+
+If the edge normalized a URL differently from the replica — trailing
+whitespace handled on one side only, say — affinity would silently route
+same-key requests to different owners and the fleet hit rate would decay
+back toward 1/N, which is exactly the failure mode this PR exists to kill.
+So every key derivation lives here, the result cache and the router both
+import it, and tests/test_ring.py pins `url_key == "url|" + affinity_key`.
+
+Normalization is deliberately conservative: the replica caches under the
+URL string it was asked to fetch, so the edge must hash the SAME string —
+anything cleverer (case-folding hosts, dropping default ports) would make
+the edge's notion of "same URL" broader than the replica's and break the
+affinity == cache-key invariant this module pins.
+"""
+
+import hashlib
+
+
+def normalize_url(url: str) -> str:
+    """Canonical URL string for keying: whitespace-stripped, otherwise the
+    exact string the replica will fetch (see module docstring for why no
+    deeper canonicalization)."""
+    return url.strip()
+
+
+def affinity_key(url: str) -> str:
+    """The edge router's rendezvous-hash key for a URL. By construction the
+    replica's negative-cache key for the same URL is `"url|" + this`."""
+    return normalize_url(url)
+
+
+def url_key(url: str) -> str:
+    """Negative-cache key for a deterministic fetch failure (content
+    unknown — the URL is the only identity we have)."""
+    return f"url|{normalize_url(url)}"
+
+
+def content_key(model_name: str, image_bytes: bytes, threshold: float) -> str:
+    """The content-addressed key: model + sha256(bytes) + threshold bucket.
+
+    The threshold is bucketed to 2 decimals so float formatting noise can't
+    split otherwise-identical deployments into disjoint key spaces.
+    """
+    digest = hashlib.sha256(image_bytes).hexdigest()
+    return f"{model_name}|{digest}|t{threshold:.2f}"

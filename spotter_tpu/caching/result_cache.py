@@ -28,14 +28,18 @@ CONTAINED here — a broken cache degrades to a miss / skipped fill, never to
 a failed request.
 """
 
-import hashlib
 import json
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict
 from typing import Optional
 
+# key derivation lives in caching/keys.py (ISSUE 11): the edge router's
+# affinity key and these cache keys must come from ONE module so they can
+# never drift. Re-exported here for existing importers.
+from spotter_tpu.caching.keys import content_key, url_key  # noqa: F401
 from spotter_tpu.serving.resilience import _env_float
 from spotter_tpu.testing import faults
 
@@ -44,6 +48,7 @@ logger = logging.getLogger(__name__)
 CACHE_MAX_MB_ENV = "SPOTTER_TPU_CACHE_MAX_MB"
 CACHE_TTL_ENV = "SPOTTER_TPU_CACHE_TTL_S"
 CACHE_NEGATIVE_TTL_ENV = "SPOTTER_TPU_CACHE_NEGATIVE_TTL_S"
+CACHE_ANNOTATED_ENV = "SPOTTER_TPU_CACHE_ANNOTATED"
 
 DEFAULT_CACHE_MAX_MB = 0.0  # disabled: caching is an explicit deployment opt-in
 DEFAULT_CACHE_TTL_S = 600.0
@@ -51,21 +56,6 @@ DEFAULT_CACHE_NEGATIVE_TTL_S = 30.0
 # negative entries are bounded by count (they carry an exception, not
 # detections, so the byte budget is the wrong ruler)
 MAX_NEGATIVE_ENTRIES = 4096
-
-
-def content_key(model_name: str, image_bytes: bytes, threshold: float) -> str:
-    """The content-addressed key: model + sha256(bytes) + threshold bucket.
-
-    The threshold is bucketed to 2 decimals so float formatting noise can't
-    split otherwise-identical deployments into disjoint key spaces.
-    """
-    digest = hashlib.sha256(image_bytes).hexdigest()
-    return f"{model_name}|{digest}|t{threshold:.2f}"
-
-
-def url_key(url: str) -> str:
-    """Negative-cache key for a deterministic fetch failure (content unknown)."""
-    return f"url|{url}"
 
 
 class ResultCache:
@@ -79,15 +69,27 @@ class ResultCache:
         negative_ttl_s: float = DEFAULT_CACHE_NEGATIVE_TTL_S,
         metrics=None,
         clock=time.monotonic,
+        annotated: Optional[bool] = None,
     ) -> None:
         self.max_bytes = int(max_bytes)
         self.ttl_s = ttl_s
         self.negative_ttl_s = negative_ttl_s
         self.metrics = metrics
         self._clock = clock
+        # annotated-JPEG sidecar (ISSUE 11 satellite): hits can skip the
+        # redundant decode+draw+re-encode when the entry also carries the
+        # finished JPEG; default on, SPOTTER_TPU_CACHE_ANNOTATED=0 keeps
+        # detections-only entries (PR 5 behavior)
+        if annotated is None:
+            annotated = os.environ.get(CACHE_ANNOTATED_ENV, "1").strip() not in (
+                "", "0",
+            )
+        self.annotated = bool(annotated)
         self._lock = threading.Lock()
-        # key -> (detections, nbytes, expires_at)
-        self._entries: OrderedDict[str, tuple[list, int, float]] = OrderedDict()
+        # key -> [detections, nbytes, expires_at, annotated]; `annotated`
+        # is None or {"jpeg": bytes, "detections": [{"label","box"}]} —
+        # one entry, one eviction unit, one byte budget
+        self._entries: OrderedDict[str, list] = OrderedDict()
         # key -> (exception, expires_at)
         self._negative: OrderedDict[str, tuple[BaseException, float]] = OrderedDict()
         self._bytes = 0
@@ -128,6 +130,16 @@ class ResultCache:
         byte budget still bounds it) instead of dropped. The fresh path is
         unchanged: expired entries are dropped and miss.
         """
+        detections, stale, _ = self.get_entry_full(key, stale_ok=stale_ok)
+        return detections, stale
+
+    def get_entry_full(
+        self, key: str, stale_ok: bool = False
+    ) -> tuple[Optional[list], bool, Optional[dict]]:
+        """(detections, is_stale, annotated) — `annotated` is the sidecar
+        {"jpeg": bytes, "detections": [...]} when a previous hit/miss
+        attached the finished draw output (ISSUE 11 satellite), else None.
+        Same hit/miss/stale accounting as `get_entry`."""
         try:
             faults.on_cache("get", key)
             with self._lock:
@@ -139,16 +151,22 @@ class ResultCache:
                     stale = False
                 if entry is None:
                     self._record("record_cache_miss")
-                    return None, False
+                    return None, False, None
                 self._entries.move_to_end(key)
                 self._record("record_cache_hit")
                 if stale:
                     self._record("record_stale_served")
-                return [dict(d) for d in entry[0]], stale
+                annotated = entry[3]
+                if annotated is not None:
+                    annotated = {
+                        "jpeg": annotated["jpeg"],
+                        "detections": [dict(d) for d in annotated["detections"]],
+                    }
+                return [dict(d) for d in entry[0]], stale, annotated
         except Exception:
             logger.exception("result cache get(%s) failed; treating as miss", key)
             self._record("record_cache_miss")
-            return None, False
+            return None, False, None
 
     def put(self, key: str, detections: list) -> None:
         """Fill (idempotent; last writer wins). Oversized values — bigger
@@ -162,18 +180,61 @@ class ResultCache:
             with self._lock:
                 if key in self._entries:
                     self._drop(key)
-                self._entries[key] = (value, nbytes, self._clock() + self.ttl_s)
+                self._entries[key] = [value, nbytes, self._clock() + self.ttl_s, None]
                 self._bytes += nbytes
-                evicted = 0
-                while self._bytes > self.max_bytes and self._entries:
-                    oldest = next(iter(self._entries))
-                    self._drop(oldest)
-                    evicted += 1
-                if evicted and self.metrics is not None:
-                    self.metrics.record_cache_eviction(evicted)
+                self._evict_over_budget()
                 self._publish_size()
         except Exception:
             logger.exception("result cache put(%s) failed; skipping fill", key)
+
+    def attach_annotated(
+        self, key: str, jpeg: bytes, detections: list[dict]
+    ) -> None:
+        """Attach the finished draw output (annotated JPEG + the amenity-
+        filtered label/box list) to an existing fresh entry so the next hit
+        skips decode+draw+re-encode entirely. The sidecar lives and dies
+        with the entry — one eviction unit — and its bytes count against
+        the same budget; a JPEG that would blow the whole budget is simply
+        not attached (the detections-only entry keeps serving)."""
+        if not self.annotated:
+            return
+        try:
+            faults.on_cache("put", key)
+            extra = len(jpeg) + self._estimate_nbytes("", detections)
+            with self._lock:
+                entry = self._entries.get(key)
+                if (
+                    entry is None
+                    or entry[3] is not None
+                    or entry[2] <= self._clock()
+                ):
+                    return
+                if entry[1] + extra > self.max_bytes:
+                    return
+                entry[3] = {
+                    "jpeg": jpeg,
+                    "detections": [dict(d) for d in detections],
+                }
+                entry[1] += extra
+                self._bytes += extra
+                # freshly useful: don't let the attach itself evict the key
+                self._entries.move_to_end(key)
+                self._evict_over_budget()
+                self._publish_size()
+        except Exception:
+            logger.exception(
+                "result cache attach_annotated(%s) failed; skipping", key
+            )
+
+    def _evict_over_budget(self) -> None:
+        # caller holds the lock
+        evicted = 0
+        while self._bytes > self.max_bytes and self._entries:
+            oldest = next(iter(self._entries))
+            self._drop(oldest)
+            evicted += 1
+        if evicted and self.metrics is not None:
+            self.metrics.record_cache_eviction(evicted)
 
     # -- negative entries ----------------------------------------------------
 
@@ -198,6 +259,25 @@ class ResultCache:
             )
             return None
 
+    def peek_negative(self, key: str) -> Optional[tuple[BaseException, float]]:
+        """(exception, remaining_ttl_s) for a live verdict, else None —
+        WITHOUT counting a negative hit or touching LRU order. The replica
+        HTTP layer uses this to surface verdicts in `X-Spotter-Negative`
+        response headers (ISSUE 11): observation, not consumption."""
+        try:
+            with self._lock:
+                entry = self._negative.get(key)
+                if entry is None:
+                    return None
+                remaining = entry[1] - self._clock()
+                if remaining <= 0:
+                    del self._negative[key]
+                    return None
+                return entry[0], remaining
+        except Exception:
+            logger.exception("result cache peek_negative(%s) failed", key)
+            return None
+
     def put_negative(self, key: str, exc: BaseException) -> None:
         try:
             faults.on_cache("put_negative", key)
@@ -220,6 +300,9 @@ class ResultCache:
                 "bytes": self._bytes,
                 "max_bytes": self.max_bytes,
                 "negative_entries": len(self._negative),
+                "annotated_entries": sum(
+                    1 for e in self._entries.values() if e[3] is not None
+                ),
                 "ttl_s": self.ttl_s,
                 "negative_ttl_s": self.negative_ttl_s,
             }

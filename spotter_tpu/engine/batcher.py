@@ -248,6 +248,14 @@ class MicroBatcher:
     def draining(self) -> bool:
         return self._draining or self._closed
 
+    def in_flight(self, key: str) -> bool:
+        """True while a keyed entry for `key` is in flight — a submit with
+        this key right now would coalesce onto it instead of enqueuing new
+        engine work (the detector's X-Cache: coalesced observation,
+        ISSUE 11)."""
+        entry = self._keyed.get(key)
+        return entry is not None and not entry[0].done()
+
     def attach_lifecycle(self, tracker) -> None:
         """Give the batcher the replica's StartupTracker so a degraded
         rebuild can re-enter `warming` (and return to `ready`) on /startupz."""

@@ -109,6 +109,15 @@ class Metrics:
         self._padding_waste_pct: deque[float] = deque(maxlen=window)
         self._slack_at_dispatch_ms: deque[float] = deque(maxlen=window)
         self._ragged_packs_total = 0
+        # Edge data plane (ISSUE 11): bytes on the /detect wire in each
+        # direction plus how many responses went out as binary frames vs
+        # default JSON — the measured substrate for the ≥25% bytes-per-
+        # request claim (wire_bytes_out_per_request in snapshot()).
+        self._wire_bytes_in_total = 0
+        self._wire_bytes_out_total = 0
+        self._wire_requests_total = 0
+        self._wire_frame_responses_total = 0
+        self._wire_json_responses_total = 0
         # Device-efficiency plane (ISSUE 10): MFU/duty-cycle accounting,
         # compile ledger, HBM gauges, and SLO burn-rate. The ledger is
         # stdlib-only and owns its own lock; the engine feeds dispatches
@@ -251,6 +260,18 @@ class Metrics:
         content hash instead of enqueuing its own image."""
         with self._lock:
             self._coalesced_submits_total += n
+
+    def record_wire(self, bytes_in: int, bytes_out: int, frame: bool) -> None:
+        """One /detect exchange's bytes on the wire (ISSUE 11): request body
+        in, response body out, and which encoding the response used."""
+        with self._lock:
+            self._wire_bytes_in_total += int(bytes_in)
+            self._wire_bytes_out_total += int(bytes_out)
+            self._wire_requests_total += 1
+            if frame:
+                self._wire_frame_responses_total += 1
+            else:
+                self._wire_json_responses_total += 1
 
     def record_stage_samples(self, name: str, values_ms: list[float]) -> None:
         """Feed per-item samples into a named stage histogram outside
@@ -432,6 +453,16 @@ class Metrics:
                 "coalesced_submits_total": self._coalesced_submits_total,
                 "cache_entries": self._cache_entries,
                 "cache_bytes": self._cache_bytes,
+                "wire_bytes_in_total": self._wire_bytes_in_total,
+                "wire_bytes_out_total": self._wire_bytes_out_total,
+                "wire_requests_total": self._wire_requests_total,
+                "wire_frame_responses_total": self._wire_frame_responses_total,
+                "wire_json_responses_total": self._wire_json_responses_total,
+                "wire_bytes_out_per_request": (
+                    self._wire_bytes_out_total / self._wire_requests_total
+                    if self._wire_requests_total
+                    else 0.0
+                ),
                 "admit_limit": self._admit_limit,
                 "admit_in_flight": self._admit_in_flight,
                 "admit_sheds_total": dict(self._admit_sheds_total),

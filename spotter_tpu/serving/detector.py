@@ -71,9 +71,11 @@ except ImportError:  # minimal image — fallback loop below keeps the contract
     _HAVE_TENACITY = False
 
 from spotter_tpu import obs
-from spotter_tpu.caching.result_cache import ResultCache, content_key, url_key
+from spotter_tpu.caching.keys import content_key, url_key
+from spotter_tpu.caching.result_cache import ResultCache
 from spotter_tpu.caching.singleflight import SingleFlight
 from spotter_tpu.engine.batcher import MicroBatcher
+from spotter_tpu.engine.errors import PoisonImageError
 from spotter_tpu.engine.engine import InferenceEngine
 from spotter_tpu.schemas import (
     DetectionErrorResult,
@@ -143,6 +145,29 @@ def _fetch_retryable(exc: BaseException) -> bool:
 # when SPOTTER_TPU_CACHE_MAX_MB is unset/0). Pass None to force the tier off
 # or a ResultCache instance to use it regardless of the env.
 _CACHE_FROM_ENV = object()
+
+
+def _mark_outcome(info: dict | None, url: str, outcome: str) -> None:
+    """Per-URL caching-tier outcome for the `X-Cache` header (ISSUE 11
+    satellite). First write wins: "the cache served this" outranks any
+    later bookkeeping on the same URL."""
+    if info is not None:
+        info.setdefault("cache", {}).setdefault(url, outcome)
+
+
+def _note_verdict(
+    info: dict | None, url: str, kind: str, error: str, ttl_s: float
+) -> None:
+    """Record a deterministic-failure verdict for this URL so the HTTP
+    layer can surface it in `X-Spotter-Negative` (ISSUE 11): the edge
+    router folds these into its fleet-shared negative cache. ONLY the
+    PR 5 taxonomy's deterministic failures may land here."""
+    if info is not None:
+        info.setdefault("negative", {})[url] = {
+            "kind": kind,
+            "error": error,
+            "ttl_s": ttl_s,
+        }
 
 
 class AmenitiesDetector:
@@ -305,7 +330,9 @@ class AmenitiesDetector:
                 self.cache.put_negative(url_key(url), exc)
             raise
 
-    async def _fetch_for_request(self, url: str, deadline: Deadline | None) -> bytes:
+    async def _fetch_for_request(
+        self, url: str, deadline: Deadline | None, info: dict | None = None
+    ) -> bytes:
         if self.cache is None:  # tier off: the exact pre-cache path
             fetch = self._fetch_with_retries(url, deadline)
             if deadline is not None:
@@ -313,6 +340,7 @@ class AmenitiesDetector:
             return await fetch
         cached_failure = self.cache.get_negative(url_key(url))
         if cached_failure is not None:
+            _mark_outcome(info, url, "negative")
             raise cached_failure
         return await self._fetch_flights.run(
             url,
@@ -327,19 +355,26 @@ class AmenitiesDetector:
         deadline: Deadline | None = None,
         cls: str | None = None,
         degraded: set[str] | None = None,
+        info: dict | None = None,
     ) -> ImageResult:
         # the ambient request trace (ISSUE 7): span capture below is a
         # monotonic read + list append per stage; None (recorder off, or a
         # bare library call) makes every `with obs.span(...)` a no-op
         trace = obs.current_trace()
         brownout = self.batcher.brownout
+        # brownout threshold rung (ISSUE 8): read once, up front — the
+        # annotated fast path below is only valid at the BASE threshold
+        # (the sidecar JPEG was drawn without a boost), and the filter
+        # further down must agree with that decision for this request
+        boost = brownout.threshold_boost_value() if brownout is not None else 0.0
         try:
             with obs.span(obs.FETCH, trace):
-                image_bytes = await self._fetch_for_request(url, deadline)
+                image_bytes = await self._fetch_for_request(url, deadline, info)
 
             with obs.span(obs.DECODE, trace):
                 cache_key: str | None = None
                 raw_detections: list[dict] | None = None
+                annotated: dict | None = None
                 if self.cache is not None:
                     cache_key = content_key(
                         self._cache_model, image_bytes, self._cache_threshold
@@ -349,27 +384,67 @@ class AmenitiesDetector:
                     # bisect machinery
                     cached_failure = self.cache.get_negative(cache_key)
                     if cached_failure is not None:
+                        _mark_outcome(info, url, "negative")
                         raise cached_failure
                     # brownout serve-stale rung (ISSUE 8): under sustained
                     # saturation an expired-TTL entry beats an engine pass —
                     # the response is marked `degraded: ["stale"]`
-                    raw_detections, was_stale = self.cache.get_entry(
-                        cache_key,
-                        stale_ok=brownout is not None and brownout.stale_ok(),
+                    raw_detections, was_stale, annotated = (
+                        self.cache.get_entry_full(
+                            cache_key,
+                            stale_ok=brownout is not None
+                            and brownout.stale_ok(),
+                        )
                     )
                     if was_stale and degraded is not None:
                         degraded.add("stale")
+                    if raw_detections is not None:
+                        _mark_outcome(info, url, "hit")
 
-                with Image.open(BytesIO(image_bytes)) as img_raw:
-                    # decode-bomb guard: the header-declared pixel count is
-                    # checked BEFORE convert() decodes anything
-                    # (preprocess.py)
-                    check_image_pixels(img_raw)
-                    image = img_raw.convert("RGB")
+                # annotated fast hit (ISSUE 11 satellite): the entry carries
+                # the finished JPEG + filtered boxes, so the whole pillow
+                # round trip (decode + draw + re-encode — most of PR 5's
+                # ~3.3 ms hit p50) is skipped. Only at the base threshold:
+                # a boosted view must re-filter and re-draw.
+                use_annotated = (
+                    raw_detections is not None
+                    and annotated is not None
+                    and boost == 0.0
+                )
+                if not use_annotated:
+                    with Image.open(BytesIO(image_bytes)) as img_raw:
+                        # decode-bomb guard: the header-declared pixel count
+                        # is checked BEFORE convert() decodes anything
+                        # (preprocess.py)
+                        check_image_pixels(img_raw)
+                        image = img_raw.convert("RGB")
+
+            if use_annotated:
+                with obs.span(obs.POSTPROCESS, trace):
+                    return DetectionSuccessResult(
+                        url=url,
+                        detections=[
+                            DetectionResult(
+                                label=d["label"], box=list(d["box"])
+                            )
+                            for d in annotated["detections"]
+                        ],
+                        labeled_image_base64=base64.b64encode(
+                            annotated["jpeg"]
+                        ).decode("utf-8"),
+                    )
 
             if raw_detections is None:
                 # miss: the content hash rides into the batcher for
                 # hash-level coalescing + cache fill on completion
+                if cache_key is not None:
+                    _mark_outcome(
+                        info,
+                        url,
+                        "coalesced"
+                        if self.batcher.in_flight(cache_key)
+                        else "miss",
+                    )
                 raw_detections = await self.batcher.submit(
                     image, deadline=deadline, key=cache_key, cls=cls
                 )
@@ -378,9 +453,6 @@ class AmenitiesDetector:
             # detection bar so fewer boxes survive into the draw/encode
             # path (cache entries keep the BASE threshold key — the boost
             # is a view over them, not a new key space)
-            boost = (
-                brownout.threshold_boost_value() if brownout is not None else 0.0
-            )
             if boost > 0.0:
                 eff_threshold = min(self._cache_threshold + boost, 0.99)
                 raw_detections = [
@@ -410,7 +482,27 @@ class AmenitiesDetector:
 
                 buffer = BytesIO()
                 image.save(buffer, format="JPEG")
-                image_b64 = base64.b64encode(buffer.getvalue()).decode("utf-8")
+                jpeg_bytes = buffer.getvalue()
+                image_b64 = base64.b64encode(jpeg_bytes).decode("utf-8")
+
+            # annotated sidecar fill (ISSUE 11 satellite): the next hit on
+            # this content skips the pillow work we just did. Base
+            # threshold only — a boosted view must not poison the base
+            # entry with its narrower box set — and attach_annotated
+            # itself refuses stale/absent entries.
+            if (
+                self.cache is not None
+                and cache_key is not None
+                and boost == 0.0
+            ):
+                self.cache.attach_annotated(
+                    cache_key,
+                    jpeg_bytes,
+                    [
+                        {"label": d.label, "box": list(d.box)}
+                        for d in image_detections
+                    ],
+                )
 
             return DetectionSuccessResult(
                 url=url, detections=image_detections, labeled_image_base64=image_b64
@@ -427,10 +519,25 @@ class AmenitiesDetector:
         except FetchError as e:
             if trace is not None:
                 trace.set_error("fetch_error", str(e))
+            if self.cache is not None and not e.retryable:
+                _note_verdict(
+                    info, url, "fetch", f"Fetch Error: {e}",
+                    self.cache.negative_ttl_s,
+                )
             return DetectionErrorResult(url=url, error=f"Fetch Error: {e}")
         except httpx.HTTPError as e:
             if trace is not None:
                 trace.set_error("fetch_error", str(e))
+            if (
+                self.cache is not None
+                and isinstance(e, httpx.HTTPStatusError)
+                and 400 <= e.response.status_code < 500
+                and e.response.status_code not in RETRYABLE_4XX
+            ):
+                _note_verdict(
+                    info, url, "fetch", f"HTTP Error: {e}",
+                    self.cache.negative_ttl_s,
+                )
             return DetectionErrorResult(url=url, error=f"HTTP Error: {e}")
         except Exception as e:
             tb_str = traceback.format_exc()
@@ -438,6 +545,15 @@ class AmenitiesDetector:
                 # poison/engine failures pin the trace in the flight
                 # recorder's error set under their exception type
                 trace.set_error(type(e).__name__, str(e))
+            if self.cache is not None and isinstance(e, PoisonImageError):
+                # poison is keyed by content hash in the replica cache, but
+                # the edge only knows URLs: surface the verdict against the
+                # URL that carried the bytes (short TTL bounds the harm if
+                # the URL later serves different content)
+                _note_verdict(
+                    info, url, "poison", f"Processing Error: {e}",
+                    self.cache.negative_ttl_s,
+                )
             return DetectionErrorResult(url=url, error=f"Processing Error: {e}\n{tb_str}")
 
     async def detect(
@@ -445,14 +561,23 @@ class AmenitiesDetector:
         payload: dict,
         deadline: Deadline | None = None,
         cls: str | None = None,
+        info: dict | None = None,
     ) -> DetectionResponse:
+        """`info` (ISSUE 11, optional dict) collects per-URL data-plane
+        observations for the HTTP layer: `info["cache"]` maps url ->
+        hit|miss|negative|coalesced (the X-Cache header) and
+        `info["negative"]` carries deterministic-failure verdicts for the
+        X-Spotter-Negative header. Pass None (the default) and nothing is
+        collected — the pre-ISSUE-11 path, bit-identical."""
         request = DetectionRequest.model_validate(payload)
         if deadline is None:
             deadline = Deadline.from_env()
         urls = [str(u) for u in request.image_urls]
         degraded: set[str] = set()
         tasks = [
-            self._process_single_image(u, deadline, cls=cls, degraded=degraded)
+            self._process_single_image(
+                u, deadline, cls=cls, degraded=degraded, info=info
+            )
             for u in urls
         ]
         gathered = await asyncio.gather(*tasks, return_exceptions=True)

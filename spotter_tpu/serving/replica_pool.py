@@ -333,8 +333,24 @@ class ReplicaPool:
 
     # ---- routing ----
 
-    def _pick(self, exclude: set[str]) -> Optional[Replica]:
+    def _pick(
+        self, exclude: set[str], prefer: Optional[list[str]] = None
+    ) -> Optional[Replica]:
+        """Next replica to try. `prefer` (cache-affinity routing, ISSUE 11)
+        is a ranked candidate order — the rendezvous ring's weight ordering
+        for this request's key: the first AVAILABLE preferred replica wins,
+        so a dead/ejected/draining owner deterministically falls to the
+        next-highest-weight holder instead of a random survivor. With the
+        preference order exhausted (or absent) selection is the original
+        round-robin over whatever is left."""
         now = time.monotonic()
+        if prefer:
+            for url in prefer:
+                if url in exclude:
+                    continue
+                r = self.replica_for(url)
+                if r is not None and r.available(now):
+                    return r
         candidates = [
             r for r in self.replicas
             if r.url not in exclude and r.available(now)
@@ -381,7 +397,11 @@ class ReplicaPool:
         return resp
 
     async def request(
-        self, path: str, payload: dict, headers: Optional[dict] = None
+        self,
+        path: str,
+        payload: dict,
+        headers: Optional[dict] = None,
+        prefer: Optional[list[str]] = None,
     ) -> httpx.Response:
         """POST `payload` with failover: try each distinct replica at most
         once per round, replaying on transport errors and replayable
@@ -405,7 +425,7 @@ class ReplicaPool:
                 await asyncio.sleep(self.round_pause_s)
             tried: set[str] = set()
             for attempt in range(len(self.replicas)):
-                r = self._pick(tried)
+                r = self._pick(tried, prefer)
                 if r is None:
                     if not self.has_available():
                         # everything got ejected mid-request (e.g. a storm
@@ -430,7 +450,7 @@ class ReplicaPool:
                 try:
                     if self.hedge_after_s is not None and attempt == 0:
                         resp = await self._hedged_attempt(
-                            r, tried, path, payload, headers
+                            r, tried, path, payload, headers, prefer
                         )
                     else:
                         resp = await self._attempt(r, path, payload, headers)
@@ -456,7 +476,7 @@ class ReplicaPool:
 
     async def _hedged_attempt(
         self, first: Replica, tried: set[str], path: str, payload: dict,
-        headers: Optional[dict] = None,
+        headers: Optional[dict] = None, prefer: Optional[list[str]] = None,
     ) -> httpx.Response:
         """Fire at `first`; if no answer within hedge_after_s, also fire at a
         second replica and take whichever succeeds first (the loser is
@@ -466,7 +486,7 @@ class ReplicaPool:
         done, _ = await asyncio.wait({primary}, timeout=self.hedge_after_s)
         if done:
             return primary.result()  # success or raise-through to replay
-        backup_replica = self._pick(tried | {first.url})
+        backup_replica = self._pick(tried | {first.url}, prefer)
         if backup_replica is None:  # nowhere to hedge: wait the primary out
             return await primary
         self.hedges_total += 1

@@ -1,0 +1,60 @@
+"""Rendezvous (highest-random-weight) hashing for cache-affinity routing.
+
+PR 5's result cache is per-replica, so a router that picks replicas blind
+to keys decays the fleet hit rate ~1/N as replicas scale (ROADMAP item 4;
+DeepServe makes the same point for serverless LLM state). HRW fixes that
+with no ring state to maintain: every member gets a deterministic
+pseudo-random weight per key (`blake2b(member "|" key)`), the key's owner
+is the highest weight, and the full weight ordering IS the failover plan —
+when the owner is ejected or draining, the next-highest member takes the
+key, and ONLY that key's traffic moves. Membership churn has the same
+property: adding or removing one of N members remaps ~1/N of the key space
+(the keys the new member now wins / the dead member owned) and leaves
+every other key exactly where it was, so the surviving replicas keep their
+warm caches through a preemption storm.
+
+Chosen over a vnode consistent-hash ring because the member counts here
+are small (a handful of replicas per pool): HRW is exactly balanced with
+zero tuning, needs no virtual-node bookkeeping, and `ranked()` falls out
+for free as the failover order. Scoring is O(members) per key — at fleet
+sizes of 2-64 that is nanoseconds against a millisecond HTTP hop.
+
+Stdlib-only and jax-free on purpose: the router process imports this.
+"""
+
+import hashlib
+
+
+def _score(member: str, key: str) -> int:
+    """Deterministic 64-bit weight of `member` for `key`. blake2b rather
+    than Python's `hash()`: stable across processes and PYTHONHASHSEED, so
+    every router replica computes the same placement."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(member.encode("utf-8", "surrogatepass"))
+    h.update(b"|")
+    h.update(key.encode("utf-8", "surrogatepass"))
+    return int.from_bytes(h.digest(), "big")
+
+
+class RendezvousRing:
+    """Immutable member set with per-key ownership ranking. Rebuild on
+    membership change (the router watches the pool and counts churn);
+    rebuilding is just storing the new tuple — all state is derived."""
+
+    def __init__(self, members: list[str]) -> None:
+        # sorted + deduped: placement must not depend on discovery order
+        self.members: tuple[str, ...] = tuple(sorted(set(members)))
+
+    def ranked(self, key: str) -> list[str]:
+        """Every member, highest weight first — index 0 is the owner, the
+        rest is the deterministic failover order for this key. Ties (a
+        64-bit collision) break on the member string so the order is still
+        total and identical everywhere."""
+        return sorted(
+            self.members, key=lambda m: (_score(m, key), m), reverse=True
+        )
+
+    def owner(self, key: str) -> str | None:
+        if not self.members:
+            return None
+        return max(self.members, key=lambda m: (_score(m, key), m))

@@ -1,21 +1,47 @@
-"""Tiny edge router over a ReplicaPool: `python -m spotter_tpu.serving.router`.
+"""Edge data plane over a ReplicaPool: `python -m spotter_tpu.serving.router`.
 
 The C++ manager proxy stays a deliberate pass-through (README "Decision");
 this router is the piece that sits where a client-side pool can't — in
 front of browsers/SDKs that speak plain HTTP to ONE address while the
-replica fleet behind it churns (preemptions, restarts, drains). Routes:
+replica fleet behind it churns (preemptions, restarts, drains). Since
+ISSUE 11 it is a real data plane, not just a failover proxy:
 
-- POST /detect  — forwarded through the pool (health-aware selection,
-  ejection, replay, optional hedging); a request fails only when EVERY
-  replica fails. A pool with nothing available (all ejected, or scaled to
-  zero) answers 503 IMMEDIATELY with a Retry-After derived from the
-  soonest un-ejection — it does not burn the client's deadline against an
-  empty candidate set (ISSUE 6 bugfix).
+- **Cache-affinity routing**: every image URL rendezvous-hashes
+  (serving/ring.py) onto the replica set, so same-key requests land on the
+  replica whose PR 5 result cache already holds the answer — the fleet hit
+  rate stays ≈ the single-replica hit rate instead of decaying ~1/N. A
+  request with mixed keys splits into per-owner sub-requests and
+  reassembles in order (description and `degraded` recomputed exactly the
+  way one replica would have). The ring's full weight ordering rides into
+  `ReplicaPool.request(prefer=...)`: a dead/ejected owner falls to the
+  deterministic next-highest-weight holder, keys rehash, zero client
+  failures. `SPOTTER_TPU_AFFINITY=0` restores blind round-robin.
+- **Fleet-shared negative cache**: replicas surface deterministic-failure
+  verdicts (non-retryable 4xx by URL, poison — the PR 5 taxonomy; never
+  5xx/timeouts/sheds) in `X-Spotter-Negative` response headers; the router
+  keeps a short-TTL edge verdict table (`SPOTTER_TPU_EDGE_NEGATIVE_TTL_S`,
+  0 disables) and answers known-bad URLs at the edge without burning a
+  replica round trip.
+- **Binary wire format**: `Accept: application/x-spotter-frame` negotiates
+  the length-prefixed frame (serving/wire.py) on both hops — raw JPEG
+  segments instead of base64-in-JSON. Not negotiated -> the JSON body is
+  byte-identical to the pre-frame wire contract.
+
+Routes:
+
+- POST /detect  — the data plane above, composed with health-aware
+  selection, ejection, replay, retry budgets, and the ISSUE 8 class-aware
+  edge admission; a request fails only when EVERY replica fails. A pool
+  with nothing available answers 503 IMMEDIATELY with a Retry-After
+  derived from the soonest un-ejection (ISSUE 6 bugfix).
 - GET  /healthz — 200 while at least one replica is available (the router
-  itself is an LB target).
+  itself is an LB target); reports the data-plane config.
 - GET  /livez   — router process liveness.
-- GET  /metrics — pool counters + per-replica state (ejections, replays,
-  hedges, retry-budget exhaustions, failures).
+- GET  /metrics — pool counters + per-replica state, plus
+  `wire_bytes_{in,out}_total` (and the per-request gauge),
+  `affinity_hit_rate` + ring-churn counters, and
+  `edge_negative_hits_total` — all flowing through the ISSUE 7 prom
+  renderer.
 
 Endpoints come from --endpoints or SPOTTER_TPU_REPLICAS (comma-separated
 base URLs). With --spot-endpoints (or SPOTTER_TPU_SPOT_REPLICAS) the router
@@ -26,6 +52,7 @@ the chaos suite drives the same ReplicaPool in-process.
 """
 
 import argparse
+import asyncio
 import json
 import logging
 import os
@@ -34,8 +61,10 @@ import time
 from aiohttp import web
 
 from spotter_tpu import obs
+from spotter_tpu.caching import keys
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
+from spotter_tpu.serving import wire
 from spotter_tpu.serving.fleet import (
     REQUEST_CLASS_HEADER,
     classify_request,
@@ -46,13 +75,21 @@ from spotter_tpu.serving.overload import (
     edge_limiter_from_env,
 )
 from spotter_tpu.serving.replica_pool import PoolExhaustedError, ReplicaPool
-from spotter_tpu.serving.resilience import jittered_retry_after
+from spotter_tpu.serving.resilience import _env_float, jittered_retry_after
+from spotter_tpu.serving.ring import RendezvousRing
 
 logger = logging.getLogger(__name__)
 
 REPLICAS_ENV = "SPOTTER_TPU_REPLICAS"
 SPOT_REPLICAS_ENV = "SPOTTER_TPU_SPOT_REPLICAS"
 HEDGE_ENV = "SPOTTER_TPU_HEDGE_MS"
+AFFINITY_ENV = "SPOTTER_TPU_AFFINITY"
+
+
+def affinity_from_env() -> bool:
+    """Cache-affinity routing is the default data plane; 0 restores the
+    pre-ISSUE-11 blind round-robin."""
+    return os.environ.get(AFFINITY_ENV, "1").strip() not in ("", "0")
 
 
 def edge_shed_response(limiter: AdaptiveLimiter, cls: str) -> web.Response:
@@ -71,28 +108,237 @@ def edge_shed_response(limiter: AdaptiveLimiter, cls: str) -> web.Response:
     )
 
 
+class _BadGateway(RuntimeError):
+    """A sub-response the fan-in cannot merge (non-200 in a split request,
+    malformed frame): surfaced to the client as 502."""
+
+
 def make_router_app(
-    pool: ReplicaPool, limiter: AdaptiveLimiter | None = None
+    pool: ReplicaPool,
+    limiter: AdaptiveLimiter | None = None,
+    affinity: bool | None = None,
+    edge_negative_ttl_s: float | None = None,
 ) -> web.Application:
     """`limiter` (default: `SPOTTER_TPU_ADMIT_EDGE_TARGET_MS` via
     `edge_limiter_from_env`, None = off) adds the ISSUE 8 AIMD edge gate:
     concurrency toward the replicas is bounded adaptively on observed
-    round-trip latency, shedding bulk (X-Request-Class) before slo."""
+    round-trip latency, shedding bulk (X-Request-Class) before slo.
+    `affinity` (default `SPOTTER_TPU_AFFINITY`, on) arms cache-affinity
+    routing; `edge_negative_ttl_s` (default
+    `SPOTTER_TPU_EDGE_NEGATIVE_TTL_S`, 5 s; <= 0 disables) caps the edge
+    verdict table's TTL."""
+    if affinity is None:
+        affinity = affinity_from_env()
+    if edge_negative_ttl_s is None:
+        edge_negative_ttl_s = _env_float(
+            wire.EDGE_NEGATIVE_TTL_ENV, wire.DEFAULT_EDGE_NEGATIVE_TTL_S
+        )
+    negcache = (
+        wire.EdgeNegativeCache(max_ttl_s=edge_negative_ttl_s)
+        if affinity and edge_negative_ttl_s > 0
+        else None
+    )
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["pool"] = pool
     app["edge_limiter"] = limiter
+    app["edge_negative"] = negcache
     # Edge SLO burn-rate (ISSUE 10): the device plane's burn windows,
     # measured at the edge over what CLIENTS saw — sheds (429/503) and
     # downstream 5xx spend the budget; everything else is good. This is
     # where "did the brownout ladder actually protect the SLO" is read.
     slo_burn = obs.SloBurn()
     app["slo_burn"] = slo_burn
+    # Edge wire accounting (ISSUE 11): flat counters, event-loop confined.
+    # Nested under "wire" in /metrics so the prom renderer flattens them to
+    # spotter_tpu_wire_bytes_in_total etc.
+    wire_stats = {
+        "bytes_in_total": 0,
+        "bytes_out_total": 0,
+        "replica_bytes_in_total": 0,
+        "replica_bytes_out_total": 0,
+        "requests_total": 0,
+        "frame_responses_total": 0,
+        "json_responses_total": 0,
+    }
+    app["wire_stats"] = wire_stats
+    # Affinity/ring accounting: owner-hit rate is THE fleet-cache-locality
+    # signal (affinity_hit_rate in /metrics); churn counts membership edits
+    # observed between requests (each one remaps ~1/N of the key space).
+    aff_stats = {
+        "routed_total": 0,  # sub-requests routed with a preference order
+        "owner_hits_total": 0,  # served by their top-ranked owner
+        "fallback_total": 0,  # served by a lower-ranked holder (failover)
+        "ring_members": 0,
+        "ring_rebuilds_total": 0,
+        "ring_churn_total": 0,  # members added+removed across rebuilds
+    }
+    app["affinity_stats"] = aff_stats
+    ring_state: dict = {"members": None, "ring": None}
+
+    def ring_for_pool() -> RendezvousRing:
+        members = tuple(sorted(r.url for r in pool.replicas))
+        if members != ring_state["members"]:
+            if ring_state["members"] is not None:
+                aff_stats["ring_churn_total"] += len(
+                    set(members) ^ set(ring_state["members"])
+                )
+                aff_stats["ring_rebuilds_total"] += 1
+            ring_state["members"] = members
+            ring_state["ring"] = RendezvousRing(list(members))
+            aff_stats["ring_members"] = len(members)
+        return ring_state["ring"]
 
     async def on_startup(app: web.Application) -> None:
         await pool.start()
 
     async def on_cleanup(app: web.Application) -> None:
         await pool.stop()
+
+    def _record_response(body_len: int, frame: bool) -> None:
+        wire_stats["requests_total"] += 1
+        wire_stats["bytes_out_total"] += body_len
+        if frame:
+            wire_stats["frame_responses_total"] += 1
+        else:
+            wire_stats["json_responses_total"] += 1
+
+    def _passthrough(resp, client_frame: bool) -> web.Response:
+        """Single-owner fast path: the replica's body crosses unchanged —
+        the byte-identity contract holds trivially."""
+        is_frame = resp.headers.get("content-type", "").startswith(
+            wire.FRAME_CONTENT_TYPE
+        )
+        out = web.Response(
+            status=resp.status_code,
+            body=resp.content,
+            content_type=(
+                wire.FRAME_CONTENT_TYPE if is_frame else "application/json"
+            ),
+        )
+        x_cache = resp.headers.get(wire.X_CACHE_HEADER)
+        if x_cache:
+            out.headers[wire.X_CACHE_HEADER] = x_cache
+        _record_response(len(resp.content), is_frame)
+        return out
+
+    def _absorb_sub(owner: str, resp) -> None:
+        """Per-sub-response bookkeeping: wire bytes, negative verdicts, and
+        did-the-owner-serve-it affinity accounting."""
+        wire_stats["replica_bytes_in_total"] += len(resp.content)
+        if negcache is not None:
+            negcache.absorb(resp.headers.get(wire.NEGATIVE_HEADER))
+        if owner:
+            if str(resp.url).startswith(owner + "/"):
+                aff_stats["owner_hits_total"] += 1
+            else:
+                aff_stats["fallback_total"] += 1
+
+    async def _forward_affinity(
+        urls: list[str], payload: dict, headers: dict, client_frame: bool
+    ) -> tuple[web.Response, list]:
+        """Fan-out/fan-in: group URLs by ring owner, forward each group with
+        the ring's weight ordering as the failover preference, reassemble
+        in request order. Returns (response, downstream headers list)."""
+        ring = ring_for_pool()
+        slots: list[dict | None] = [None] * len(urls)
+        x_cache_vals: list[str | None] = []
+        groups: dict[str, list[int]] = {}
+        prefer: dict[str, list[str]] = {}
+        edge_answered = 0
+        for i, u in enumerate(urls):
+            akey = keys.affinity_key(u)
+            if negcache is not None:
+                verdict = negcache.get(akey)
+                if verdict is not None:
+                    # known-bad URL: answered at the edge, zero replica work
+                    slots[i] = {"url": u, "error": verdict[0]}
+                    x_cache_vals.append("negative")
+                    edge_answered += 1
+                    continue
+            ranked = ring.ranked(akey)
+            owner = ranked[0] if ranked else ""
+            idxs = groups.setdefault(owner, [])
+            if not idxs:
+                # the group fails over as one unit, by its first key's
+                # deterministic weight order
+                prefer[owner] = ranked
+            idxs.append(i)
+
+        downstream: list = []
+        degraded: set[str] = set()
+        if groups:
+            aff_stats["routed_total"] += len(groups)
+
+            async def sub(owner: str, idxs: list[int]):
+                sub_payload = dict(payload)
+                sub_payload["image_urls"] = [urls[i] for i in idxs]
+                wire_stats["replica_bytes_out_total"] += len(
+                    wire.to_json_bytes(sub_payload)
+                )
+                return await pool.request(
+                    "/detect",
+                    sub_payload,
+                    headers=headers,
+                    prefer=prefer[owner] or None,
+                )
+
+            gathered = await asyncio.gather(
+                *(sub(o, ix) for o, ix in groups.items()),
+                return_exceptions=True,
+            )
+            for res in gathered:
+                if isinstance(res, BaseException):
+                    raise res
+            for (owner, idxs), resp in zip(groups.items(), gathered):
+                _absorb_sub(owner, resp)
+                downstream.append(resp.headers)
+                if len(groups) == 1 and not edge_answered:
+                    return _passthrough(resp, client_frame), downstream
+                if resp.status_code != 200:
+                    # a split request can't merge a replica error body;
+                    # surface the first one as a gateway failure
+                    raise _BadGateway(
+                        f"sub-request for {len(idxs)} url(s) answered "
+                        f"HTTP {resp.status_code}"
+                    )
+                ctype = resp.headers.get("content-type", "")
+                try:
+                    if ctype.startswith(wire.FRAME_CONTENT_TYPE):
+                        header, segments = wire.split_frame(resp.content)
+                    else:
+                        header, segments = wire.strip_segments(
+                            json.loads(resp.content)
+                        )
+                except (wire.FrameError, json.JSONDecodeError, TypeError) as exc:
+                    raise _BadGateway(f"unparseable sub-response: {exc}")
+                images = header.get("images") or []
+                if len(images) != len(idxs):
+                    raise _BadGateway(
+                        f"sub-response carried {len(images)} images "
+                        f"for {len(idxs)} urls"
+                    )
+                for img, i in zip(images, idxs):
+                    slot = dict(img)
+                    seg = slot.pop("image_segment", None)
+                    if seg is not None:
+                        slot["_bytes"] = segments[seg]
+                    slots[i] = slot
+                degraded.update(header.get("degraded") or [])
+                x_cache_vals.append(resp.headers.get(wire.X_CACHE_HEADER))
+
+        header, segments = wire.merge_images(slots, degraded)
+        if client_frame:
+            body = wire.build_frame(header, segments)
+            ctype = wire.FRAME_CONTENT_TYPE
+        else:
+            body = wire.to_json_bytes(wire.restore_segments(header, segments))
+            ctype = "application/json"
+        out = web.Response(status=200, body=body, content_type=ctype)
+        x_cache = wire.summarize_cache_outcomes(x_cache_vals)
+        if x_cache is not None:
+            out.headers[wire.X_CACHE_HEADER] = x_cache
+        _record_response(len(body), client_frame)
+        return out, downstream
 
     async def detect(request: web.Request) -> web.Response:
         # Edge half of the trace (ISSUE 7): mint/continue the ids, forward
@@ -112,9 +358,13 @@ def make_router_app(
             )
 
         with obs.span(obs.ROUTE, trace):
+            raw = await request.read()
+            wire_stats["bytes_in_total"] += len(raw)
             try:
-                payload = await request.json()
-            except json.JSONDecodeError:
+                payload = json.loads(raw)
+                if not isinstance(payload, dict):
+                    raise json.JSONDecodeError("not an object", "{}", 0)
+            except (json.JSONDecodeError, UnicodeDecodeError):
                 return done(web.Response(status=400, text="Invalid JSON body"))
             cls, payload = classify_request(request.headers, payload)
         adm = None
@@ -126,15 +376,43 @@ def make_router_app(
         # the class rides downstream so the replica's limiter/brownout
         # apply the same bulk-before-slo ordering
         headers[REQUEST_CLASS_HEADER] = cls
+        # wire negotiation rides downstream too: when the client speaks
+        # frames, the router->replica hop does as well — the base64 tax is
+        # paid on neither hop
+        client_frame = wire.wants_frame(request.headers.get("Accept"))
+        if client_frame:
+            headers["Accept"] = wire.FRAME_CONTENT_TYPE
+        urls = payload.get("image_urls")
+        splittable = (
+            affinity
+            and isinstance(urls, list)
+            and bool(urls)
+            and all(isinstance(u, str) for u in urls)
+        )
         t_fwd = time.monotonic()
+        downstream: list = []
         try:
-            resp = await pool.request("/detect", payload, headers=headers)
+            if splittable:
+                out, downstream = await _forward_affinity(
+                    urls, payload, headers, client_frame
+                )
+            else:
+                resp = await pool.request("/detect", payload, headers=headers)
+                downstream = [resp.headers]
+                _absorb_sub("", resp)
+                out = _passthrough(resp, client_frame)
         except PoolExhaustedError as exc:
             return done(
                 web.json_response(
                     {"error": str(exc), "status": 503},
                     status=503,
                     headers=retry_after_header(exc),
+                )
+            )
+        except _BadGateway as exc:
+            return done(
+                web.json_response(
+                    {"error": str(exc), "status": 502}, status=502
                 )
             )
         finally:
@@ -146,13 +424,21 @@ def make_router_app(
                 adm.release()
         with obs.span(obs.ROUTE, trace):
             # replica stages + the transport remainder as a network span:
-            # the edge trace tiles against the latency the client saw
-            obs_http.merge_downstream(trace, resp.headers, elapsed_s)
-            out = web.Response(
-                status=resp.status_code,
-                body=resp.content,
-                content_type="application/json",
-            )
+            # the edge trace tiles against the latency the client saw.
+            # Fanned-out sub-requests ran concurrently, so the remainder is
+            # measured against the SLOWEST hop's attributed time.
+            merged_max = 0.0
+            for hdrs in downstream:
+                merged_max = max(
+                    merged_max,
+                    obs_http.merge_server_timing(
+                        trace, hdrs.get(obs_http.SERVER_TIMING_HEADER)
+                    ),
+                )
+            if downstream and trace is not None:
+                net_ms = elapsed_s * 1e3 - merged_max
+                if net_ms > 0.0:
+                    trace.add_span_ms(obs_http.NETWORK, 0.0, net_ms)
         return done(out)
 
     async def healthz(request: web.Request) -> web.Response:
@@ -162,6 +448,12 @@ def make_router_app(
             {
                 "available_replicas": available,
                 "total_replicas": len(pool.replicas),
+                # data-plane config (ISSUE 11): auditable per edge, like
+                # the replica's dp/ragged/device_preprocess flags
+                "affinity": affinity,
+                "edge_negative_ttl_s": (
+                    negcache.max_ttl_s if negcache is not None else 0.0
+                ),
                 # edge error-budget state (ISSUE 10): same block shape as
                 # the replica's /healthz slo_burn
                 "slo_burn": slo_burn.block(),
@@ -183,6 +475,30 @@ def make_router_app(
         # prom renders slo_burn_rate{window="fast"|"slow"}
         snap["slo_target_pct"] = slo_burn.target_pct
         snap["slo_burn_rate"] = slo_burn.rates()
+        # edge data plane (ISSUE 11): wire bytes, affinity locality, ring
+        # churn, edge negative-cache hits — flattened by the prom renderer
+        # to spotter_tpu_wire_bytes_in_total, spotter_tpu_affinity_hit_rate,
+        # spotter_tpu_edge_negative_hits_total, ...
+        requests = wire_stats["requests_total"]
+        snap["wire"] = {
+            **wire_stats,
+            "bytes_out_per_request": (
+                wire_stats["bytes_out_total"] / requests if requests else 0.0
+            ),
+        }
+        routed = aff_stats["routed_total"]
+        snap["affinity"] = {
+            "enabled": affinity,
+            **aff_stats,
+            "hit_rate": (
+                aff_stats["owner_hits_total"] / routed if routed else 0.0
+            ),
+        }
+        snap["edge_negative"] = (
+            negcache.snapshot()
+            if negcache is not None
+            else {"entries": 0, "hits_total": 0, "entries_added_total": 0}
+        )
         return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)
@@ -196,7 +512,7 @@ def make_router_app(
 
 
 def main() -> None:
-    parser = argparse.ArgumentParser(description="spotter-tpu failover edge router")
+    parser = argparse.ArgumentParser(description="spotter-tpu edge data-plane router")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument(
@@ -217,6 +533,12 @@ def main() -> None:
         default=float(os.environ.get(HEDGE_ENV, "0") or "0"),
         help="hedge a second replica after this many ms (0 = off)",
     )
+    parser.add_argument(
+        "--no-affinity",
+        action="store_true",
+        help=f"disable cache-affinity routing ({AFFINITY_ENV}=0): blind "
+        "round-robin, the pre-ISSUE-11 behavior",
+    )
     args = parser.parse_args()
     endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
     spot_endpoints = [
@@ -226,6 +548,8 @@ def main() -> None:
         raise SystemExit(f"no replica endpoints: pass --endpoints or set {REPLICAS_ENV}")
     logging.basicConfig(level=logging.INFO)
     obs_logs.maybe_setup_json_logging()
+    if args.no_affinity:
+        os.environ[AFFINITY_ENV] = "0"
     if spot_endpoints:
         from spotter_tpu.serving.fleet import make_fleet_app, static_fleet
 

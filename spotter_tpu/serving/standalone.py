@@ -54,7 +54,7 @@ from aiohttp import web
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.ops import preprocess
-from spotter_tpu.serving import lifecycle
+from spotter_tpu.serving import lifecycle, wire
 from spotter_tpu.serving.fleet import classify_request
 from spotter_tpu.serving.resilience import AdmissionError
 from spotter_tpu.testing import faults, stub_engine
@@ -239,8 +239,11 @@ def make_app(
         shed = det.check_admission(cls)
         if shed is not None:  # brownout bulk shed: reject before fetching
             return done(_shed_response(shed))
+        # data-plane observations (ISSUE 11): per-URL cache outcomes for
+        # X-Cache and deterministic-failure verdicts for X-Spotter-Negative
+        info: dict = {}
         try:
-            response = await det.detect(payload, cls=cls)
+            response = await det.detect(payload, cls=cls, info=info)
         except pydantic.ValidationError as exc:
             return done(web.Response(status=400, text=f"Invalid request: {exc}"))
         except AdmissionError as exc:  # every image shed -> 429/503
@@ -248,9 +251,36 @@ def make_app(
         except Exception:
             logger.exception("detect failed")
             return done(web.Response(status=500, text="Internal server error"))
-        # exclude_none: the `degraded` marker is on the wire ONLY when a
-        # brownout concession shaped this response (schemas.py contract)
-        return done(web.json_response(response.model_dump(exclude_none=True)))
+        body = response.model_dump(exclude_none=True)
+        # binary wire format (ISSUE 11): `Accept: application/x-spotter-frame`
+        # negotiates the length-prefixed frame (raw JPEG segments, deflated
+        # header — no base64 tax). NOT negotiated -> the exact pre-existing
+        # json_response call, byte-identical on the wire (exclude_none: the
+        # `degraded` marker is absent unless a brownout concession shaped
+        # this response — schemas.py contract).
+        frame = wire.wants_frame(request.headers.get("Accept"))
+        if frame:
+            resp = web.Response(
+                body=wire.encode_frame(body),
+                content_type=wire.FRAME_CONTENT_TYPE,
+            )
+        else:
+            resp = web.json_response(body)
+        x_cache = wire.summarize_cache_outcomes(
+            (info.get("cache") or {}).values()
+        )
+        if x_cache is not None:
+            resp.headers[wire.X_CACHE_HEADER] = x_cache
+        verdicts = wire.encode_negative_header(info.get("negative") or {})
+        if verdicts is not None:
+            resp.headers[wire.NEGATIVE_HEADER] = verdicts
+        out_bytes = resp.body
+        det.engine.metrics.record_wire(
+            request.content_length or 0,
+            len(out_bytes) if isinstance(out_bytes, (bytes, bytearray)) else 0,
+            frame,
+        )
+        return done(resp)
 
     async def startupz(request: web.Request) -> web.Response:
         """Startup probe: 200 only once the replica reached ready. A long

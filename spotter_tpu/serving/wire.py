@@ -1,0 +1,422 @@
+"""Binary wire format + data-plane headers for the /detect hot path.
+
+JSON+base64 is the reference wire contract and stays the default — byte
+identical when nothing is negotiated. But base64 is a ~33% tax on every
+annotated JPEG this service returns (detector.py pays it on every
+success), and at fleet scale that tax is paid twice per request (replica →
+router → client). A client or edge that sends
+
+    Accept: application/x-spotter-frame
+
+gets the same response as a length-prefixed binary frame instead: the
+JSON body with every `labeled_image_base64` string swapped for an
+`image_segment` index into raw JPEG segments appended after the header,
+and the header itself deflate-compressed (the detection dicts and
+description are highly compressible JSON; raw JPEG is not, so ONLY the
+header is compressed).
+
+Frame layout (all integers big-endian):
+
+    offset  size  field
+    0       4     magic "SPTF"
+    4       1     version (1)
+    5       1     flags (bit 0: header is zlib-deflated)
+    6       2     reserved (0)
+    8       4     segment count N
+    12      4     header length H
+    16      H     header JSON (per flags, possibly deflated)
+    16+H    ...   N segments, each: u32 length + raw bytes
+
+The header JSON is exactly the `DetectionResponse.model_dump(
+exclude_none=True)` dict, except each success image carries
+`"image_segment": <idx>` in place of `"labeled_image_base64"`. Decoding
+restores the base64 field, so `decode_frame(encode_frame(x)) == x` and a
+frame can be re-serialized to the byte-identical default JSON with
+`to_json_bytes` (the router does this when it speaks frames to replicas
+but JSON to a legacy client).
+
+Also here: the additive data-plane headers —
+
+- `X-Cache: hit|miss|negative|coalesced` (ISSUE 11 satellite): how the
+  caching tier treated this request, so tests and the affinity bench can
+  observe hit locality without scraping /metrics. Multi-image requests
+  summarize: any negative verdict -> "negative", else all images cached ->
+  "hit", else any coalesced and the rest cached -> "coalesced", else
+  "miss".
+- `X-Spotter-Negative`: the replica's deterministic-failure verdicts
+  (non-retryable 4xx by URL, poison by content hash — surfaced against the
+  URL that carried the bytes), RFC-8941-ish
+  `u=<quoted-url>;k=<kind>;t=<ttl>;e=<quoted-error>` items, comma-joined.
+  The router folds them into its `EdgeNegativeCache` so a known-bad URL is
+  answered at the edge without burning a replica round trip. Only the
+  PR 5 taxonomy's deterministic failures ride here — 5xx/timeouts/sheds
+  are retryable and never become verdicts.
+
+Stdlib-only and jax-free: the router process imports this.
+"""
+
+import base64
+import json
+import struct
+import time
+import zlib
+from urllib.parse import quote, unquote
+
+from spotter_tpu.caching.keys import normalize_url
+
+FRAME_CONTENT_TYPE = "application/x-spotter-frame"
+FRAME_MAGIC = b"SPTF"
+FRAME_VERSION = 1
+_FLAG_DEFLATED = 0x01  # header is zlib-compressed
+_FLAG_DICT = 0x02  # header is RAW deflate against the preset dictionary
+_HEAD = struct.Struct(">4sBBHII")  # magic, version, flags, reserved, nseg, hlen
+_U32 = struct.Struct(">I")
+
+# Preset deflate dictionary (the SPDY header-dict trick): the response
+# vocabulary is fixed protocol-side, so seeding the compressor with it
+# roughly halves the compressed header for small responses — which is what
+# pushes the total frame saving past the bare ~25% base64 tax even for
+# single-image responses. Changing this dictionary is a WIRE CHANGE: bump
+# FRAME_VERSION with it.
+FRAME_ZDICT = json.dumps(
+    {
+        "amenities_description": (
+            "The property contains: No relevant amenities detected."
+        ),
+        "images": [
+            {
+                "url": "https://http://",
+                "detections": [{"label": "", "box": []}],
+                "image_segment": 0,
+                "error": (
+                    "Fetch Error: HTTP Error: Processing Error: "
+                    "Deadline exceeded: Overloaded: "
+                ),
+            }
+        ],
+        "degraded": ["stale", "bucket_cap", "threshold"],
+    },
+    separators=(",", ":"),
+).encode("utf-8")
+
+X_CACHE_HEADER = "X-Cache"
+NEGATIVE_HEADER = "X-Spotter-Negative"
+
+# cap the per-verdict error text: headers are not a payload channel
+_MAX_ERROR_CHARS = 200
+
+EDGE_NEGATIVE_TTL_ENV = "SPOTTER_TPU_EDGE_NEGATIVE_TTL_S"
+DEFAULT_EDGE_NEGATIVE_TTL_S = 5.0
+MAX_EDGE_NEGATIVE_ENTRIES = 4096
+
+
+class FrameError(ValueError):
+    """Malformed frame (bad magic/version, truncated segment, bad index)."""
+
+
+def wants_frame(accept: str | None) -> bool:
+    """Content negotiation: the frame is opt-in per request via Accept."""
+    return bool(accept) and FRAME_CONTENT_TYPE in accept.lower()
+
+
+def to_json_bytes(body: dict) -> bytes:
+    """The default wire encoding — byte-identical to what
+    `aiohttp.web.json_response(body)` puts on the socket (plain
+    `json.dumps`), so a frame-decoded response re-encodes to exactly the
+    bytes a non-negotiating client would have received."""
+    return json.dumps(body).encode("utf-8")
+
+
+# -- frame encode/decode -----------------------------------------------------
+
+
+def strip_segments(body: dict) -> tuple[dict, list[bytes]]:
+    """(header, segments): every success image's base64 payload decoded out
+    into a raw segment, the image dict rewritten with `image_segment`. The
+    input dict is not mutated."""
+    segments: list[bytes] = []
+    header = dict(body)
+    images = []
+    for img in body.get("images", ()):
+        b64 = img.get("labeled_image_base64") if isinstance(img, dict) else None
+        if b64 is None:
+            images.append(img)
+            continue
+        out = {k: v for k, v in img.items() if k != "labeled_image_base64"}
+        out["image_segment"] = len(segments)
+        segments.append(base64.b64decode(b64))
+        images.append(out)
+    header["images"] = images
+    return header, segments
+
+
+def restore_segments(header: dict, segments: list[bytes]) -> dict:
+    """Inverse of `strip_segments`: base64 back in, `image_segment` gone."""
+    body = dict(header)
+    images = []
+    for img in header.get("images", ()):
+        idx = img.get("image_segment") if isinstance(img, dict) else None
+        if idx is None:
+            images.append(img)
+            continue
+        if not isinstance(idx, int) or not 0 <= idx < len(segments):
+            raise FrameError(f"image_segment {idx!r} out of range")
+        out = {k: v for k, v in img.items() if k != "image_segment"}
+        out["labeled_image_base64"] = base64.b64encode(
+            segments[idx]
+        ).decode("utf-8")
+        images.append(out)
+    body["images"] = images
+    return body
+
+
+def build_frame(header: dict, segments: list[bytes]) -> bytes:
+    """Serialize an already-split (header, segments) pair. The header is
+    deflated when that actually shrinks it (it always does for real
+    responses; tiny test fixtures may not)."""
+    raw = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    co = zlib.compressobj(9, zlib.DEFLATED, -15, zdict=FRAME_ZDICT)
+    deflated = co.compress(raw) + co.flush()
+    flags = 0
+    if len(deflated) < len(raw):
+        raw, flags = deflated, _FLAG_DEFLATED | _FLAG_DICT
+    parts = [
+        _HEAD.pack(FRAME_MAGIC, FRAME_VERSION, flags, 0, len(segments), len(raw)),
+        raw,
+    ]
+    for seg in segments:
+        parts.append(_U32.pack(len(seg)))
+        parts.append(seg)
+    return b"".join(parts)
+
+
+def split_frame(data: bytes) -> tuple[dict, list[bytes]]:
+    """Parse a frame into (header, segments) without touching base64 — the
+    router's merge path re-frames segments as-is."""
+    if len(data) < _HEAD.size:
+        raise FrameError(f"frame truncated at {len(data)} bytes")
+    magic, version, flags, _, nseg, hlen = _HEAD.unpack_from(data, 0)
+    if magic != FRAME_MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if version != FRAME_VERSION:
+        raise FrameError(f"unsupported frame version {version}")
+    off = _HEAD.size
+    if len(data) < off + hlen:
+        raise FrameError("frame header truncated")
+    raw = data[off:off + hlen]
+    off += hlen
+    if flags & _FLAG_DEFLATED:
+        try:
+            if flags & _FLAG_DICT:
+                do = zlib.decompressobj(-15, zdict=FRAME_ZDICT)
+                raw = do.decompress(raw) + do.flush()
+            else:
+                raw = zlib.decompress(raw)
+        except zlib.error as exc:
+            raise FrameError(f"bad deflated header: {exc}") from None
+    try:
+        header = json.loads(raw)
+    except json.JSONDecodeError as exc:
+        raise FrameError(f"bad header JSON: {exc}") from None
+    if not isinstance(header, dict):
+        raise FrameError("frame header is not an object")
+    segments: list[bytes] = []
+    for _ in range(nseg):
+        if len(data) < off + _U32.size:
+            raise FrameError("frame segment table truncated")
+        (seg_len,) = _U32.unpack_from(data, off)
+        off += _U32.size
+        if len(data) < off + seg_len:
+            raise FrameError("frame segment truncated")
+        segments.append(data[off:off + seg_len])
+        off += seg_len
+    return header, segments
+
+
+def encode_frame(body: dict) -> bytes:
+    """JSON-shaped response dict (base64 images) -> frame bytes."""
+    header, segments = strip_segments(body)
+    return build_frame(header, segments)
+
+
+def decode_frame(data: bytes) -> dict:
+    """Frame bytes -> the JSON-shaped response dict (base64 restored)."""
+    return restore_segments(*split_frame(data))
+
+
+# -- fan-in merge ------------------------------------------------------------
+
+
+def merge_images(
+    image_slots: list[dict | None], degraded: set[str]
+) -> tuple[dict, list[bytes]]:
+    """Reassemble one response from per-image slots gathered across owners
+    (split-frame image dicts — `image_segment` entries carry a `_bytes` key
+    with the raw segment). Recomputes `amenities_description` exactly the
+    way the detector does (sorted label union over successes), so a merged
+    response is indistinguishable from a single replica having served every
+    URL. Returns a (header, segments) pair ready for `build_frame` or
+    `restore_segments`."""
+    amenities: set[str] = set()
+    images: list[dict] = []
+    segments: list[bytes] = []
+    for slot in image_slots:
+        img = dict(slot) if slot is not None else {"url": "", "error": "missing"}
+        raw = img.pop("_bytes", None)
+        if raw is not None:
+            img["image_segment"] = len(segments)
+            segments.append(raw)
+        if "detections" in img:
+            amenities.update(
+                d.get("label") for d in img["detections"]
+                if isinstance(d, dict) and d.get("label")
+            )
+        images.append(img)
+    description = (
+        f"The property contains: {', '.join(sorted(amenities))}."
+        if amenities
+        else "No relevant amenities detected."
+    )
+    header: dict = {"amenities_description": description, "images": images}
+    if degraded:
+        header["degraded"] = sorted(degraded)
+    return header, segments
+
+
+# -- X-Cache summary ---------------------------------------------------------
+
+
+def summarize_cache_outcomes(outcomes) -> str | None:
+    """One `X-Cache` value for a (possibly multi-image) request; None when
+    the caching tier produced no observation (tier off)."""
+    seen = [o for o in outcomes if o]
+    if not seen:
+        return None
+    if "negative" in seen:
+        return "negative"
+    if all(o == "hit" for o in seen):
+        return "hit"
+    if "coalesced" in seen and all(o in ("hit", "coalesced") for o in seen):
+        return "coalesced"
+    return "miss"
+
+
+# -- negative-verdict header -------------------------------------------------
+
+
+def encode_negative_header(verdicts: dict[str, dict]) -> str | None:
+    """{url: {"kind", "ttl_s", "error"}} -> header value (None when empty)."""
+    items = []
+    for url, v in verdicts.items():
+        err = str(v.get("error", ""))[:_MAX_ERROR_CHARS]
+        items.append(
+            f"u={quote(url, safe='')};k={v.get('kind', 'fetch')}"
+            f";t={float(v.get('ttl_s', 0.0)):.1f};e={quote(err, safe='')}"
+        )
+    return ", ".join(items) if items else None
+
+
+def parse_negative_header(value: str | None) -> list[dict]:
+    """Header value -> [{url, kind, ttl_s, error}]; malformed items are
+    skipped (a half-parsed verdict must degrade to a replica round trip,
+    never to a wrong edge answer)."""
+    out: list[dict] = []
+    if not value:
+        return out
+    for item in value.split(","):
+        fields: dict[str, str] = {}
+        for part in item.strip().split(";"):
+            k, sep, v = part.partition("=")
+            if sep:
+                fields[k.strip()] = v
+        url = fields.get("u")
+        if not url:
+            continue
+        try:
+            ttl_s = float(fields.get("t", "0"))
+        except ValueError:
+            continue
+        if ttl_s <= 0:
+            continue
+        out.append(
+            {
+                "url": unquote(url),
+                "kind": fields.get("k", "fetch"),
+                "ttl_s": ttl_s,
+                "error": unquote(fields.get("e", "")),
+            }
+        )
+    return out
+
+
+class EdgeNegativeCache:
+    """The router's short-TTL verdict table: fleet-shared negative cache
+    (ISSUE 11). Entries come ONLY from replica `X-Spotter-Negative` headers
+    (i.e. the replica's own deterministic-failure taxonomy); the edge TTL
+    is the MIN of the replica's remaining TTL and the edge cap, so the edge
+    can never remember a verdict longer than the replica that issued it.
+    Event-loop confined (router handler only) — no lock."""
+
+    def __init__(
+        self,
+        max_ttl_s: float = DEFAULT_EDGE_NEGATIVE_TTL_S,
+        clock=time.monotonic,
+    ) -> None:
+        self.max_ttl_s = max_ttl_s
+        self._clock = clock
+        # url -> (error, kind, expires_at)
+        self._entries: dict[str, tuple[str, str, float]] = {}
+        self.hits_total = 0
+        self.entries_added_total = 0
+
+    def put(self, url: str, error: str, kind: str, ttl_s: float) -> None:
+        # keyed by the SAME normalization the affinity ring uses
+        # (caching/keys.py) so a verdict recorded off one replica's header
+        # is found by the lookup the router does per request URL
+        url = normalize_url(url)
+        ttl = min(float(ttl_s), self.max_ttl_s)
+        if ttl <= 0:
+            return
+        if len(self._entries) >= MAX_EDGE_NEGATIVE_ENTRIES and url not in self._entries:
+            self._purge()
+            if len(self._entries) >= MAX_EDGE_NEGATIVE_ENTRIES:
+                return  # full of live verdicts: drop, never evict live ones
+        self._entries[url] = (error, kind, self._clock() + ttl)
+        self.entries_added_total += 1
+
+    def get(self, url: str) -> tuple[str, str] | None:
+        """(error, kind) for a live verdict, else None; counts the hit."""
+        url = normalize_url(url)
+        entry = self._entries.get(url)
+        if entry is None:
+            return None
+        if entry[2] <= self._clock():
+            del self._entries[url]
+            return None
+        self.hits_total += 1
+        return entry[0], entry[1]
+
+    def absorb(self, header_value: str | None) -> int:
+        """Fold one replica response's verdict header in; returns count."""
+        verdicts = parse_negative_header(header_value)
+        for v in verdicts:
+            self.put(v["url"], v["error"], v["kind"], v["ttl_s"])
+        return len(verdicts)
+
+    def _purge(self) -> None:
+        now = self._clock()
+        dead = [u for u, e in self._entries.items() if e[2] <= now]
+        for u in dead:
+            del self._entries[u]
+
+    def snapshot(self) -> dict:
+        # nested under "edge_negative" in the router snapshot, so these
+        # flatten to edge_negative_{hits,entries_added}_total in the prom
+        # exposition
+        self._purge()
+        return {
+            "entries": len(self._entries),
+            "max_ttl_s": self.max_ttl_s,
+            "hits_total": self.hits_total,
+            "entries_added_total": self.entries_added_total,
+        }

@@ -14,6 +14,7 @@ Never production: the standalone server logs loudly when stub mode is on,
 the same way it does for SPOTTER_TPU_FAULTS.
 """
 
+import hashlib
 import os
 import time
 from io import BytesIO
@@ -26,11 +27,11 @@ STUB_SERVICE_MS_ENV = "SPOTTER_TPU_STUB_SERVICE_MS"
 STUB_DETECTIONS = [{"label": "tv", "score": 0.9, "box": [2.0, 2.0, 20.0, 24.0]}]
 
 
-def stub_image_bytes(w: int = 32, h: int = 32) -> bytes:
+def stub_image_bytes(w: int = 32, h: int = 32, fill: int = 128) -> bytes:
     import numpy as np
     from PIL import Image
 
-    img = Image.fromarray(np.full((h, w, 3), 128, np.uint8))
+    img = Image.fromarray(np.full((h, w, 3), fill % 256, np.uint8))
     buf = BytesIO()
     img.save(buf, format="JPEG")
     return buf.getvalue()
@@ -112,13 +113,26 @@ class _StubResponse:
 
 class StubHttpClient:
     """Replaces the detector's httpx.AsyncClient in stub mode: every GET
-    "fetches" the same tiny JPEG without touching the network."""
+    "fetches" a tiny canned JPEG without touching the network. DISTINCT
+    URLs get DISTINCT bytes (fill value from the URL hash, ISSUE 11) so
+    content-addressed cache keys behave like real traffic — affinity
+    benches over stub replicas measure per-URL hit locality, not one
+    degenerate shared key. A small encode memo keeps repeat fetches free."""
+
+    _MEMO_MAX = 64
 
     def __init__(self) -> None:
-        self._bytes = stub_image_bytes()
+        self._memo: dict[int, bytes] = {}
 
     async def get(self, url: str) -> _StubResponse:
-        return _StubResponse(self._bytes)
+        fill = hashlib.blake2b(url.encode(), digest_size=1).digest()[0]
+        body = self._memo.get(fill)
+        if body is None:
+            if len(self._memo) >= self._MEMO_MAX:
+                self._memo.clear()
+            body = stub_image_bytes(fill=fill)
+            self._memo[fill] = body
+        return _StubResponse(body)
 
     async def aclose(self) -> None:
         pass

@@ -129,6 +129,81 @@ def test_preemption_file_drains_then_supervisor_restarts(tmp_path):
     assert "preempted" in out  # supervisor logged the distinct exit path
 
 
+def test_affinity_routing_rehashes_on_replica_death(two_replicas):
+    """ISSUE 11 acceptance: kill 1 of 2 replicas mid-load under
+    cache-affinity routing — every key whose owner died must rehash to the
+    deterministic next-highest-weight holder (the ring's failover order
+    rides into ReplicaPool.request(prefer=...)) with ZERO client-visible
+    failures, and the router must keep answering with correctly-ordered
+    multi-URL responses throughout."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.serving.router import make_router_app
+
+    victim, survivor = two_replicas
+    # distinct URLs spread over both owners: a mixed-key request fans out
+    urls = [f"http://example.com/listing-{i}.jpg" for i in range(6)]
+
+    async def run():
+        pool = ReplicaPool(
+            [victim.url, survivor.url],
+            eject_threshold=1,
+            backoff_base_s=0.2,
+            health_interval_s=0.1,
+        )
+        app = make_router_app(pool, affinity=True)
+        errors: list = []
+        bodies: list[dict] = []
+        killed = {"pid": None}
+        async with TestClient(TestServer(app)) as client:
+
+            async def one_request():
+                try:
+                    resp = await client.post(
+                        "/detect", json={"image_urls": urls}
+                    )
+                    assert resp.status == 200, await resp.text()
+                    bodies.append(await resp.json())
+                except BaseException as exc:
+                    errors.append(exc)
+
+            async def load(n=40, concurrency=6):
+                sem = asyncio.Semaphore(concurrency)
+
+                async def bounded():
+                    async with sem:
+                        await one_request()
+
+                await asyncio.gather(*(bounded() for _ in range(n)))
+
+            async def chaos():
+                await asyncio.sleep(0.3)
+                killed["pid"] = victim.kill_child(signal.SIGKILL)
+
+            await asyncio.gather(load(), chaos())
+            metrics = await (await client.get("/metrics")).json()
+        return errors, bodies, killed, metrics
+
+    errors, bodies, killed, metrics = asyncio.run(run())
+    assert killed["pid"] is not None
+    assert errors == [], f"affinity routing leaked {len(errors)}: {errors[:3]}"
+    assert len(bodies) == 40
+    # fan-in order held through the failover: every response carries every
+    # URL, in request order
+    for body in bodies:
+        assert [img["url"] for img in body["images"]] == urls
+        assert body["amenities_description"]
+    # the data plane actually routed with the ring, and the dead owner's
+    # keys fell to the survivor (fallback served at least one sub-request)
+    assert metrics["affinity"]["enabled"] is True
+    assert metrics["affinity"]["routed_total"] > 0
+    assert metrics["affinity"]["fallback_total"] > 0, (
+        "no key ever fell to a lower-ranked holder — the kill was invisible?"
+    )
+    # the killed replica comes back via its supervisor
+    cluster.wait_ready(victim.url, timeout_s=30.0)
+
+
 def test_drain_window_stays_clean_through_pool(two_replicas):
     """Graceful path: draining one replica (preStop) mid-load must also be
     invisible — the pool sees 503s and routes around it."""

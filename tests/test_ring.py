@@ -1,0 +1,103 @@
+"""Rendezvous-ring + key-normalization contract (ISSUE 11).
+
+The properties that make cache-affinity routing safe to turn on by
+default: placement is deterministic across processes, membership churn
+moves only ~1/N of the key space, an ejected owner falls to the
+deterministic next-highest-weight holder, and the edge's affinity key can
+never drift from the replica's cache URL key because both come from
+caching/keys.py.
+"""
+
+from collections import Counter
+
+from spotter_tpu.caching import keys
+from spotter_tpu.caching import result_cache
+from spotter_tpu.serving.ring import RendezvousRing
+
+MEMBERS = [f"http://127.0.0.1:80{i:02d}" for i in range(4)]
+KEYS = [f"http://cdn.example.com/listing-{i}/photo.jpg" for i in range(1000)]
+
+
+def test_deterministic_placement_across_instances():
+    a = RendezvousRing(MEMBERS)
+    b = RendezvousRing(list(reversed(MEMBERS)))  # discovery order must not matter
+    for k in KEYS[:100]:
+        assert a.owner(k) == b.owner(k)
+        assert a.ranked(k) == b.ranked(k)
+        # ranked is a permutation of the membership with the owner first
+        assert sorted(a.ranked(k)) == sorted(MEMBERS)
+        assert a.ranked(k)[0] == a.owner(k)
+
+
+def test_balanced_distribution():
+    ring = RendezvousRing(MEMBERS)
+    counts = Counter(ring.owner(k) for k in KEYS)
+    assert set(counts) == set(MEMBERS)
+    for member, n in counts.items():
+        # 1000 keys over 4 members: expect ~250 each; generous slack keeps
+        # the test hash-stable while still catching gross imbalance
+        assert 150 <= n <= 350, f"{member} owns {n}/1000 keys"
+
+
+def test_member_join_moves_about_one_in_n_keys():
+    before = {k: RendezvousRing(MEMBERS).owner(k) for k in KEYS}
+    grown = RendezvousRing(MEMBERS + ["http://127.0.0.1:8099"])
+    moved = 0
+    for k in KEYS:
+        now = grown.owner(k)
+        if now != before[k]:
+            moved += 1
+            # HRW invariant: a key only ever moves TO the new member —
+            # every other key keeps its exact placement (warm caches
+            # survive the scale-out)
+            assert now == "http://127.0.0.1:8099"
+    # expected 1/5 = 200 of 1000, with slack for hash variance
+    assert 120 <= moved <= 280, f"join moved {moved}/1000 keys"
+
+
+def test_member_leave_moves_only_its_keys():
+    full = RendezvousRing(MEMBERS)
+    before = {k: full.owner(k) for k in KEYS}
+    shrunk = RendezvousRing(MEMBERS[:-1])
+    for k in KEYS:
+        if before[k] == MEMBERS[-1]:
+            # orphaned keys land on the key's next-ranked survivor
+            assert shrunk.owner(k) == full.ranked(k)[1]
+        else:
+            assert shrunk.owner(k) == before[k]
+
+
+def test_ejected_owner_falls_to_next_highest_weight():
+    ring = RendezvousRing(MEMBERS)
+    k = KEYS[0]
+    ranked = ring.ranked(k)
+    # the failover plan is the weight ordering itself: skipping the dead
+    # owner yields the same replica every router instance would pick
+    available = [m for m in ranked if m != ranked[0]]
+    assert available[0] == ranked[1]
+    # draining the top TWO holders still yields a deterministic third
+    assert [m for m in ranked if m not in ranked[:2]][0] == ranked[2]
+
+
+def test_affinity_key_equals_replica_cache_url_key():
+    """The drift pin: the edge hashes `affinity_key(url)`, the replica
+    stores negative verdicts under `url_key(url)`; both MUST be the same
+    normalization with only the namespace prefix differing."""
+    for url in (
+        "http://cdn.example.com/a.jpg",
+        "  http://cdn.example.com/a.jpg \n",
+        "https://CDN.example.com/Path%20/x.jpg?w=1",
+    ):
+        assert keys.url_key(url) == "url|" + keys.affinity_key(url)
+    # and the result cache re-exports THE SAME functions, not copies —
+    # a future edit cannot fork the derivation
+    assert result_cache.url_key is keys.url_key
+    assert result_cache.content_key is keys.content_key
+
+
+def test_empty_and_single_member_rings():
+    assert RendezvousRing([]).owner("k") is None
+    assert RendezvousRing([]).ranked("k") == []
+    solo = RendezvousRing(["http://only"])
+    assert solo.owner("k") == "http://only"
+    assert solo.ranked("k") == ["http://only"]
