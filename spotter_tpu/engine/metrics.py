@@ -5,6 +5,8 @@ The reference has no metrics endpoint (SURVEY.md §5.5); the north-star targets
 counters + a bounded reservoir; snapshot() is what /metrics serves.
 """
 
+import os
+import socket
 import threading
 import time
 from collections import deque
@@ -21,6 +23,33 @@ LATENCY_BUCKETS_MS = (
     5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
     float("inf"),
 )
+
+# Per-stage bucket bounds (ms) for the MERGEABLE stage histograms
+# (ISSUE 12): the point p50/p90/p99 stage summaries cannot be aggregated
+# across replicas (an average of medians is not a fleet median), so every
+# snapshot also carries raw cumulative bucket counts per stage. Finer than
+# the batch-latency ladder — stage slices (h2d, postprocess) are routinely
+# sub-millisecond.
+STAGE_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+    2500.0, float("inf"),
+)
+
+REPLICA_ID_ENV = "SPOTTER_TPU_REPLICA_ID"
+
+
+def default_replica_id() -> str:
+    """Stable-per-process replica identity: the env override wins (fleet
+    operators can pin pod names), else host:pid — unique across a fleet
+    and across restarts on one host."""
+    rid = os.environ.get(REPLICA_ID_ENV, "").strip()
+    if rid:
+        return rid
+    try:
+        host = socket.gethostname() or "localhost"
+    except OSError:
+        host = "localhost"
+    return f"{host}:{os.getpid()}"
 
 
 class Metrics:
@@ -43,6 +72,19 @@ class Metrics:
         # corrupt each other's view
         self._arrivals: deque[tuple[float, int]] = deque(maxlen=window)
         self._stages: dict[str, deque[float]] = {}
+        # Mergeable stage state (ISSUE 12): name -> [bucket_counts, sum,
+        # count]. Cumulative (never windowed) so fleet aggregation adds
+        # bucket counts across replicas exactly like Prometheus would.
+        self._stage_hist: dict[str, list] = {}
+        # Replica identity stamp (ISSUE 12): every snapshot carries who
+        # produced it, so cross-replica aggregation, staleness tracking,
+        # and restart detection (generation bump => counter reset) are
+        # principled rather than heuristic. Generation defaults to the
+        # supervisor's restart count (set_restarts); the model name is
+        # stamped by the serving bootstrap once it knows it.
+        self._replica_id = default_replica_id()
+        self._model: str | None = None
+        self._generation = 0
         # Resilience counters (ISSUE 1): overload shedding, deadline expiry,
         # watchdog batch timeouts, breaker state/transitions, drain state.
         self._shed_total = 0
@@ -171,7 +213,9 @@ class Metrics:
                         ring = self._stages[name] = deque(
                             maxlen=self._latencies_ms.maxlen
                         )
-                    ring.append(secs * 1000.0)
+                    ms = secs * 1000.0
+                    ring.append(ms)
+                    self._stage_hist_observe(name, ms)
 
     def record_error(self, n: int = 1) -> None:
         with self._lock:
@@ -287,6 +331,38 @@ class Metrics:
                     maxlen=self._latencies_ms.maxlen
                 )
             ring.extend(values_ms)
+            for ms in values_ms:
+                self._stage_hist_observe(name, ms)
+
+    def _stage_hist_observe(self, name: str, ms: float) -> None:
+        """Cumulative per-stage bucket counts (caller holds the lock)."""
+        h = self._stage_hist.get(name)
+        if h is None:
+            h = self._stage_hist[name] = [[0] * len(STAGE_BUCKETS_MS), 0.0, 0]
+        counts = h[0]
+        for i, le in enumerate(STAGE_BUCKETS_MS):
+            if ms <= le:
+                counts[i] += 1
+                break
+        h[1] += ms
+        h[2] += 1
+
+    def set_identity(
+        self,
+        model: str | None = None,
+        replica_id: str | None = None,
+        generation: int | None = None,
+    ) -> None:
+        """Stamp the snapshot identity block (ISSUE 12). Only non-None
+        fields change, so the bootstrap can stamp the model name without
+        clobbering a generation the supervisor already set."""
+        with self._lock:
+            if model is not None:
+                self._model = model
+            if replica_id is not None:
+                self._replica_id = replica_id
+            if generation is not None:
+                self._generation = int(generation)
 
     def set_admit_state(self, limit: int, in_flight: int) -> None:
         """The AIMD limiter publishes its state on every control tick."""
@@ -359,6 +435,11 @@ class Metrics:
     def set_restarts(self, n: int) -> None:
         with self._lock:
             self._restarts_total = n
+            # restart count IS the counter-reset generation: every process
+            # restart starts the cumulative counters over from zero, and
+            # the fleet aggregator folds the previous generation's totals
+            # into its base when it sees this number move (ISSUE 12)
+            self._generation = int(n)
 
     def snapshot(self) -> dict:
         # outside the metrics lock: the perf ledger locks itself, and
@@ -400,6 +481,25 @@ class Metrics:
                     [None if le == float("inf") else le, cumulative]
                 )
 
+            # mergeable stage histograms (ISSUE 12): the raw cumulative
+            # bucket counts behind the point summaries above — fleet
+            # aggregation adds these across replicas and recomputes the
+            # quantiles, instead of averaging averages
+            stage_hists = {}
+            for name, (counts, total_ms, n) in self._stage_hist.items():
+                cum = 0
+                sbuckets = []
+                for le, c in zip(STAGE_BUCKETS_MS, counts):
+                    cum += c
+                    sbuckets.append(
+                        [None if le == float("inf") else le, cum]
+                    )
+                stage_hists[name] = {
+                    "buckets": sbuckets,
+                    "sum": round(total_ms, 3),
+                    "count": n,
+                }
+
             # ragged-scheduling stats (ISSUE 9): windowed mean waste + a
             # slack quantile summary (obs/prom.py renders the dict with
             # {quantile="..."} labels)
@@ -421,6 +521,17 @@ class Metrics:
             return {
                 **perf_snap,
                 **stage_stats,
+                # identity stamp (ISSUE 12): who produced this snapshot —
+                # the substrate for fleet aggregation (staleness, restart
+                # detection via generation, per-replica labels)
+                "replica": {
+                    "replica_id": self._replica_id,
+                    "pid": os.getpid(),
+                    "generation": self._generation,
+                    "uptime_s": round(now - self._started, 3),
+                    "model": self._model,
+                },
+                "stage_ms_histogram": stage_hists,
                 "padding_waste_pct": waste,
                 "slack_at_dispatch_ms": slack_summary,
                 "ragged_packs_total": self._ragged_packs_total,

@@ -165,10 +165,16 @@ def merge_downstream(
         trace.add_span_ms(NETWORK, 0.0, net_ms)
 
 
-def make_debug_traces_handler(recorder: FlightRecorder | None = None):
+def make_debug_traces_handler(
+    recorder: FlightRecorder | None = None, aggregator=None
+):
     """GET /debug/traces (admin-token-gated): the full flight-recorder
     snapshot, or `?request_id=<id>` / `?trace_id=<id>` for one request's
-    trace(s)."""
+    trace(s). With an `aggregator` (a FleetAggregator — edge apps only),
+    `?fleet=1` stitches edge traces with the owning replica's recorder
+    spans by trace id: no id -> the edge's slowest-K (bounded by `?k=`),
+    an id -> that one request, end-to-end. The caller's admin token is
+    forwarded to the member /debug/traces gates."""
 
     async def debug_traces(request: web.Request) -> web.Response:
         rejected = admin_rejection(request)
@@ -179,6 +185,24 @@ def make_debug_traces_handler(recorder: FlightRecorder | None = None):
             request.query.get("request_id", "").strip()
             or request.query.get("trace_id", "").strip()
         )
+        if aggregator is not None and request.query.get(
+            "fleet", ""
+        ).strip().lower() in ("1", "true", "yes"):
+            try:
+                k = int(request.query.get("k", "0")) or None
+            except ValueError:
+                return web.Response(status=400, text="k must be an integer")
+            fwd = {}
+            token = request.headers.get(ADMIN_TOKEN_HEADER, "")
+            if token:
+                fwd[ADMIN_TOKEN_HEADER] = token
+            payload = await aggregator.stitched_traces(
+                rec, trace_id=key or None, k=k, headers=fwd
+            )
+            # a specific id that matched nothing is a 404, like the
+            # single-process lookup; the list view is 200 even when empty
+            status = 404 if (key and not payload["stitched"]) else 200
+            return web.json_response(payload, status=status)
         if key:
             matches = rec.lookup(key)
             return web.json_response(
@@ -188,6 +212,21 @@ def make_debug_traces_handler(recorder: FlightRecorder | None = None):
         return web.json_response(rec.snapshot())
 
     return debug_traces
+
+
+def make_debug_fleet_handler(aggregator):
+    """GET /debug/fleet (admin-token-gated, like /debug/traces and
+    /debug/perf): the aggregator's merged fleet view plus the per-replica
+    table — goodput, p50/p99, burn, MFU, HBM, brownout rung, cache hit
+    rate per member, with staleness and generation state."""
+
+    async def debug_fleet(request: web.Request) -> web.Response:
+        rejected = admin_rejection(request)
+        if rejected is not None:
+            return rejected
+        return web.json_response(aggregator.fleet_snapshot())
+
+    return debug_fleet
 
 
 def make_debug_perf_handler(metrics_getter):

@@ -192,6 +192,21 @@ class SloBurn:
             "slow": round(self.burn(SLOW_WINDOW_S), 4),
         }
 
+    def export(self) -> dict:
+        """Raw good/bad second-buckets as [age_s, good, bad] triples
+        (ISSUE 12): ages instead of absolute seconds because monotonic
+        clocks don't compare across processes. The fleet aggregator sums
+        these across replicas and recomputes burn from the merged counts —
+        a fleet burn rate is never an average of member burn rates."""
+        with self._lock:
+            sec_now = int(time.monotonic())
+            buckets = [
+                [sec_now - sec, g, b]
+                for sec, (g, b) in sorted(self._buckets.items())
+                if 0 <= sec_now - sec <= int(SLOW_WINDOW_S)
+            ]
+        return {"target_pct": self.target_pct, "buckets": buckets}
+
     def block(self) -> dict:
         """The /healthz `slo_burn` block: windows, counts, and burn."""
         with self._lock:
@@ -466,7 +481,19 @@ class PerfLedger:
             "hbm_per_device": hbm,
             "slo_target_pct": self.slo.target_pct,
             "slo_burn_rate": self.slo.rates(),
+            # mergeable raw state (ISSUE 12): the window sums behind
+            # mfu/duty so fleet MFU recomputes as sum(flops)/sum(span*peak)
+            # across replicas — never an average of member percentages
+            "perf_raw": {
+                "window_span_s": round(span, 3),
+                "device_s": round(dev_s, 6),
+                "flops": flops,
+                "useful_flops": useful,
+                "peak_flops": peak_flops or 0.0,
+            },
         }
+        # outside self._lock: SloBurn owns its own lock
+        out["slo_burn_raw"] = self.slo.export()
         out.update(self.compiles.snapshot())
         return out
 

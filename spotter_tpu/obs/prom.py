@@ -47,8 +47,16 @@ _QUANTILE_TAGS = {"p50": "0.5", "p90": "0.9", "p99": "0.99"}
 # snapshot keys handled specially (never via the generic walk) — plus the
 # compile-shape table (ISSUE 10), which is a per-shape list for /debug/perf
 # and the JSON view; the exposition carries its aggregates
-# (compiles_total / compile_seconds_total / program_cache_hits_total)
-_SKIP_KEYS = {"latency_ms_histogram", "pools", "dp_degraded", "compile_shapes"}
+# (compiles_total / compile_seconds_total / program_cache_hits_total).
+# The ISSUE 12 merge substrate (raw stage buckets, raw burn second-buckets,
+# raw MFU window sums, the identity stamp) is JSON-only: it exists so the
+# fleet aggregator can recompute quantiles/burn/MFU from raw state, and
+# skipping it keeps this exposition byte-identical to the pre-fleet
+# rendering (test-pinned).
+_SKIP_KEYS = {
+    "latency_ms_histogram", "pools", "dp_degraded", "compile_shapes",
+    "stage_ms_histogram", "slo_burn_raw", "perf_raw", "replica",
+}
 
 
 def _name(*parts: str) -> str:

@@ -117,6 +117,14 @@ class FlightRecorder:
             if t.trace_id == key or t.request_id == key
         ]
 
+    def slowest_traces(self, k: int | None = None) -> list[dict]:
+        """The pinned slowest traces, slowest-first (ISSUE 12): the edge
+        set the fleet trace stitcher joins against replica recorders —
+        cheap (no ring/error serialization) compared to snapshot()."""
+        with self._lock:
+            top = [t.to_dict() for t in self._slowest]
+        return top[: k if k is not None else self.slowest_k]
+
     def trace_ids_between(self, t0_wall: float, t1_wall: float) -> list[str]:
         """Trace ids of recorded traces whose [start, end] wall-clock window
         overlaps [t0_wall, t1_wall] — the /profile <-> flight-recorder join

@@ -59,6 +59,7 @@ from aiohttp import web
 from spotter_tpu import obs
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
+from spotter_tpu.obs.aggregate import FleetAggregator
 from spotter_tpu.serving.replica_pool import (
     PoolExhaustedError,
     ReplicaPool,
@@ -654,8 +655,18 @@ def retry_after_header(exc: PoolExhaustedError) -> dict[str, str]:
     return {"Retry-After": f"{max(1, round(getattr(exc, 'retry_after_s', 1.0)))}"}
 
 
+def fleet_member_urls(controller: FleetController) -> list[str]:
+    """Every member URL across every pool — the fleet aggregator's
+    membership source (re-read each scrape, so spot churn, respawns and
+    scale-to-zero are followed)."""
+    return [
+        m.url for fp in controller.pools.values() for m in fp.members
+    ]
+
+
 def make_fleet_app(
-    controller: FleetController, limiter=None
+    controller: FleetController, limiter=None,
+    aggregator: FleetAggregator | None = None,
 ) -> web.Application:
     """The fleet edge: /detect classifies (header/payload) and routes
     through the controller; /metrics serves the pool gauges the storm bench
@@ -663,15 +674,23 @@ def make_fleet_app(
     `limiter` (an `overload.AdaptiveLimiter`, default off; armed via
     `SPOTTER_TPU_ADMIT_EDGE_TARGET_MS` by the entrypoints) is the ISSUE 8
     AIMD edge gate: adaptive concurrency on observed round-trip latency,
-    shedding bulk before slo when the limit is hit."""
+    shedding bulk before slo when the limit is hit. `aggregator` (default:
+    built over every pool's members from `SPOTTER_TPU_FLEET_SCRAPE_S`; 0
+    disables) is the ISSUE 12 fleet telemetry plane — the merged `fleet`
+    /metrics block, /debug/fleet, and /debug/traces?fleet=1 stitching."""
+    if aggregator is None:
+        aggregator = FleetAggregator(lambda: fleet_member_urls(controller))
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["fleet"] = controller
     app["edge_limiter"] = limiter
+    app["fleet_aggregator"] = aggregator
 
     async def on_startup(app: web.Application) -> None:
         await controller.start()
+        await aggregator.start()
 
     async def on_cleanup(app: web.Application) -> None:
+        await aggregator.stop()
         await controller.stop()
 
     async def detect(request: web.Request) -> web.Response:
@@ -755,13 +774,25 @@ def make_fleet_app(
         snap = controller.snapshot()
         if limiter is not None:
             snap["edge_admit"] = limiter.snapshot()
+        # fleet telemetry plane (ISSUE 12): the merged member view across
+        # every pool — the single answer to "what is the fleet's goodput/
+        # burn/MFU right now", and the autoscaling signal source for
+        # ROADMAP item 2
+        if aggregator.enabled:
+            snap["fleet"] = aggregator.fleet_snapshot()
         return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/livez", livez)
     app.router.add_get("/metrics", metrics)
-    app.router.add_get("/debug/traces", obs_http.make_debug_traces_handler())
+    app.router.add_get(
+        "/debug/traces",
+        obs_http.make_debug_traces_handler(aggregator=aggregator),
+    )
+    app.router.add_get(
+        "/debug/fleet", obs_http.make_debug_fleet_handler(aggregator)
+    )
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app

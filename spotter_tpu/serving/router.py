@@ -41,7 +41,14 @@ Routes:
   `wire_bytes_{in,out}_total` (and the per-request gauge),
   `affinity_hit_rate` + ring-churn counters, and
   `edge_negative_hits_total` — all flowing through the ISSUE 7 prom
-  renderer.
+  renderer. With the ISSUE 12 aggregator armed (default), a `fleet` block
+  carries the merged member view: counters summed reset-aware, fleet
+  p50/p99/burn/MFU recomputed from raw state, per-replica gauges labeled
+  by url.
+- GET  /debug/fleet — admin-gated per-replica table (goodput, p50/p99,
+  burn, MFU, HBM, brownout rung, cache hit rate, staleness/generation).
+- GET  /debug/traces?fleet=1 — the edge's slowest-K traces stitched with
+  the owning replica's flight-recorder spans by trace id.
 
 Endpoints come from --endpoints or SPOTTER_TPU_REPLICAS (comma-separated
 base URLs). With --spot-endpoints (or SPOTTER_TPU_SPOT_REPLICAS) the router
@@ -64,6 +71,7 @@ from spotter_tpu import obs
 from spotter_tpu.caching import keys
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
+from spotter_tpu.obs.aggregate import FleetAggregator
 from spotter_tpu.serving import wire
 from spotter_tpu.serving.fleet import (
     REQUEST_CLASS_HEADER,
@@ -118,6 +126,7 @@ def make_router_app(
     limiter: AdaptiveLimiter | None = None,
     affinity: bool | None = None,
     edge_negative_ttl_s: float | None = None,
+    aggregator: FleetAggregator | None = None,
 ) -> web.Application:
     """`limiter` (default: `SPOTTER_TPU_ADMIT_EDGE_TARGET_MS` via
     `edge_limiter_from_env`, None = off) adds the ISSUE 8 AIMD edge gate:
@@ -126,7 +135,11 @@ def make_router_app(
     `affinity` (default `SPOTTER_TPU_AFFINITY`, on) arms cache-affinity
     routing; `edge_negative_ttl_s` (default
     `SPOTTER_TPU_EDGE_NEGATIVE_TTL_S`, 5 s; <= 0 disables) caps the edge
-    verdict table's TTL."""
+    verdict table's TTL. `aggregator` (default: built over the pool's
+    members from `SPOTTER_TPU_FLEET_SCRAPE_S`, 2 s; 0 disables) is the
+    ISSUE 12 fleet telemetry plane: member /metrics scraped and merged
+    into a `fleet` block on this /metrics, the /debug/fleet per-replica
+    table, and /debug/traces?fleet=1 cross-replica trace stitching."""
     if affinity is None:
         affinity = affinity_from_env()
     if edge_negative_ttl_s is None:
@@ -138,10 +151,13 @@ def make_router_app(
         if affinity and edge_negative_ttl_s > 0
         else None
     )
+    if aggregator is None:
+        aggregator = FleetAggregator(lambda: [r.url for r in pool.replicas])
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["pool"] = pool
     app["edge_limiter"] = limiter
     app["edge_negative"] = negcache
+    app["fleet_aggregator"] = aggregator
     # Edge SLO burn-rate (ISSUE 10): the device plane's burn windows,
     # measured at the edge over what CLIENTS saw — sheds (429/503) and
     # downstream 5xx spend the budget; everything else is good. This is
@@ -190,8 +206,10 @@ def make_router_app(
 
     async def on_startup(app: web.Application) -> None:
         await pool.start()
+        await aggregator.start()  # no-op when SPOTTER_TPU_FLEET_SCRAPE_S=0
 
     async def on_cleanup(app: web.Application) -> None:
+        await aggregator.stop()
         await pool.stop()
 
     def _record_response(body_len: int, frame: bool) -> None:
@@ -499,13 +517,26 @@ def make_router_app(
             if negcache is not None
             else {"entries": 0, "hits_total": 0, "entries_added_total": 0}
         )
+        # fleet telemetry plane (ISSUE 12): the merged member view —
+        # counters summed (reset-aware), quantiles/burn/MFU recomputed
+        # from raw state, per-replica rows labeled {url=...} in the prom
+        # exposition. This is THE single scrape target for "what is the
+        # fleet's goodput/burn/MFU right now".
+        if aggregator.enabled:
+            snap["fleet"] = aggregator.fleet_snapshot()
         return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/livez", livez)
     app.router.add_get("/metrics", metrics)
-    app.router.add_get("/debug/traces", obs_http.make_debug_traces_handler())
+    app.router.add_get(
+        "/debug/traces",
+        obs_http.make_debug_traces_handler(aggregator=aggregator),
+    )
+    app.router.add_get(
+        "/debug/fleet", obs_http.make_debug_fleet_handler(aggregator)
+    )
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app

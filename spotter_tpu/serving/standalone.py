@@ -145,6 +145,19 @@ def make_app(
             faults.FAULTS_ENV,
         )
 
+    def _stamp_identity(det) -> None:
+        # fleet-mergeable snapshot identity (ISSUE 12): the model name
+        # joins replica_id/pid/generation in every /metrics snapshot so
+        # the aggregator's per-replica table and restart detection are
+        # principled. Generation itself rides set_restarts (below).
+        model = (
+            model_name
+            or os.environ.get("MODEL_NAME")
+            or ("stub" if stub_engine.stub_mode_enabled() else None)
+        )
+        if model is not None:
+            det.engine.metrics.set_identity(model=model)
+
     def _wire_fault_domain(det) -> None:
         det.batcher.attach_lifecycle(tracker)
         if det.batcher.fatal_exit_cb is None:
@@ -166,6 +179,7 @@ def make_app(
 
     if detector is not None:
         detector.engine.metrics.set_restarts(lifecycle.restarts_from_env())
+        _stamp_identity(detector)
         _wire_fault_domain(detector)
         tracker.mark_ready(detector.engine.metrics)
 
@@ -180,6 +194,7 @@ def make_app(
                 await loop.run_in_executor(None, det.engine.warmup)
             app["detector"] = det
             det.engine.metrics.set_restarts(lifecycle.restarts_from_env())
+            _stamp_identity(det)
             _wire_fault_domain(det)
             ttr = tracker.mark_ready(det.engine.metrics)
             logger.info("replica ready in %.1f s", ttr)

@@ -48,6 +48,11 @@ class StubEngine:
             service_ms = float(raw) if raw else 0.0
         self.service_s = max(service_ms, 0.0) / 1000.0
         self.metrics = Metrics()
+        # identity stamp (ISSUE 12): stub fleets exercise the same
+        # mergeable-snapshot contract the real engine carries, so the
+        # aggregator's per-replica table and restart detection work in
+        # the model-free chaos/bench harnesses too
+        self.metrics.set_identity(model="stub")
         self.batch_buckets = (1, 2, 4, 8)
 
     def warmup(self) -> None:  # parity with InferenceEngine's surface

@@ -1,0 +1,134 @@
+"""fleet_top: top(1) for a spotter-tpu fleet (ISSUE 12 satellite).
+
+Polls a fleet edge's `/metrics` JSON (router or fleet app with the
+FleetAggregator armed) and renders one line per replica — state, rps, p99,
+SLO burn, MFU, brownout rung — above a fleet summary line, for operators
+and bench debugging:
+
+    python tools/fleet_top.py http://edge:8080 [--interval 2] [--once]
+
+Stdlib-only (urllib), plain text by default: `watch`-friendly, pipes into
+logs, and `--once` makes it scriptable. With a TTY and no `--once`, the
+screen is redrawn in place (ANSI home+clear — no curses dependency to
+gate). `--token` forwards X-Admin-Token; /metrics itself is ungated, the
+flag exists for edges fronted by auth proxies that expect the header.
+
+Reads the `fleet` block the aggregator embeds in /metrics. An edge with
+the aggregator disabled (SPOTTER_TPU_FLEET_SCRAPE_S=0) has no such block;
+that is reported rather than rendered as an empty fleet.
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+COLUMNS = (
+    # (header, width, row key, formatter)
+    ("REPLICA", 28, "url", str),
+    ("STATE", 7, None, None),  # synthesized from up/stale
+    ("GEN", 4, "generation", lambda v: str(int(v or 0))),
+    ("MODEL", 14, "model", lambda v: str(v or "-")),
+    ("RPS", 8, "images_per_sec", lambda v: f"{float(v or 0):.1f}"),
+    ("P50MS", 8, "latency_ms_p50", lambda v: f"{float(v or 0):.1f}"),
+    ("P99MS", 8, "latency_ms_p99", lambda v: f"{float(v or 0):.1f}"),
+    ("BURN", 7, "slo_burn_fast", lambda v: f"{float(v or 0):.2f}"),
+    ("MFU%", 6, "mfu_pct", lambda v: f"{float(v or 0):.1f}"),
+    ("DUTY%", 6, "device_duty_cycle_pct", lambda v: f"{float(v or 0):.1f}"),
+    ("HIT%", 6, "cache_hit_rate", lambda v: f"{100.0 * float(v or 0):.0f}"),
+    ("RUNG", 4, "brownout_rung", lambda v: str(int(v or 0))),
+)
+
+
+def _state(row: dict) -> str:
+    if not row.get("up"):
+        return "down"
+    if row.get("stale"):
+        return "stale"
+    return "ready"
+
+
+def render(snapshot: dict) -> str:
+    """The whole screen as text from one edge /metrics JSON snapshot.
+    Pure (testable): no I/O, no clock."""
+    fleet = snapshot.get("fleet")
+    if not isinstance(fleet, dict):
+        return (
+            "no `fleet` block in /metrics — is the aggregator armed "
+            "(SPOTTER_TPU_FLEET_SCRAPE_S > 0) on this edge?"
+        )
+    reps = fleet.get("replicas") or {}
+    burn = fleet.get("slo_burn_rate") or {}
+    head = (
+        f"fleet: {reps.get('up', 0)}/{reps.get('seen', 0)} up "
+        f"({reps.get('stale', 0)} stale, "
+        f"{reps.get('generation_resets_total', 0)} restarts) | "
+        f"goodput {float(fleet.get('images_per_sec', 0) or 0):.1f} img/s | "
+        f"p99 {float(fleet.get('latency_ms_p99', 0) or 0):.1f} ms | "
+        f"burn {float(burn.get('fast', 0) or 0):.2f}/"
+        f"{float(burn.get('slow', 0) or 0):.2f} | "
+        f"mfu {float(fleet.get('mfu_pct', 0) or 0):.1f}% | "
+        f"rung {int(fleet.get('brownout_rung', 0) or 0)}"
+    )
+    lines = [head, ""]
+    header = "  ".join(h.ljust(w) for h, w, _, _ in COLUMNS)
+    lines.append(header)
+    for row in fleet.get("per_replica") or []:
+        cells = []
+        for _h, w, key, fmt in COLUMNS:
+            if key is None:
+                cell = _state(row)
+            else:
+                try:
+                    cell = fmt(row.get(key))
+                except (TypeError, ValueError):
+                    cell = "-"
+            cells.append(cell[:w].ljust(w))
+        lines.append("  ".join(cells))
+    if not fleet.get("per_replica"):
+        lines.append("(no replicas scraped yet)")
+    return "\n".join(lines)
+
+
+def fetch(url: str, token: str | None = None, timeout_s: float = 3.0) -> dict:
+    req = urllib.request.Request(f"{url.rstrip('/')}/metrics")
+    req.add_header("Accept", "application/json")
+    if token:
+        req.add_header("X-Admin-Token", token)
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="top(1)-style view over a spotter-tpu fleet edge"
+    )
+    parser.add_argument("url", help="fleet edge base URL (router/fleet app)")
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (scripting / bench debugging)",
+    )
+    parser.add_argument("--token", default=None, help="X-Admin-Token value")
+    args = parser.parse_args(argv)
+    redraw = sys.stdout.isatty() and not args.once
+    while True:
+        try:
+            frame = render(fetch(args.url, args.token))
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as exc:
+            frame = f"fleet edge unreachable: {exc}"
+        if redraw:
+            sys.stdout.write("\x1b[H\x1b[2J")
+        print(frame, flush=True)
+        if args.once:
+            return 0
+        try:
+            time.sleep(max(args.interval, 0.2))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
