@@ -2358,6 +2358,203 @@ def multichip_serve_bench(args) -> int:
     return 0
 
 
+def tp_serve_bench(args) -> int:
+    """Tensor-parallel serving, measured not asserted (ISSUE 13): tiny
+    OWL-ViT + tiny RT-DETR through the REAL engine on a virtual dp×tp CPU
+    mesh — tp=2/tp=4 forward parity vs tp=1 (score/box tolerance), aggregate
+    throughput + scaling efficiency, per-device HBM gauges for every mesh
+    device, the per-param sharding ratio at tp=2 on a ViT-L-class tree
+    (eval_shape, no init paid), and the text-embedding-cache hit p50 vs miss
+    p50 for the open-vocab workload. CPU ok (the quantity under test is the
+    tp machinery, not chip speed); every gate is testable before real
+    silicon. Prints ONE bench_compare-valid JSON line; exits non-zero when
+    a parity/cache gate fails.
+    """
+    import os
+
+    # virtual devices for CPU runs: must land in XLA_FLAGS before the first
+    # jax import of this process
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={args.tp_devices}"
+        ).strip()
+    os.environ.setdefault("SPOTTER_TPU_TINY", "1")
+
+    import jax
+    from PIL import Image
+
+    from spotter_tpu.caching.text_cache import TextQueryResolver
+    from spotter_tpu.engine.engine import InferenceEngine
+    from spotter_tpu.models import build_detector
+    from spotter_tpu.models.registry import family_for
+    from spotter_tpu.parallel import make_mesh, sharding_report, OWLVIT_TP_RULES
+
+    n_dev = len(jax.local_devices())
+    bucket = args.tp_bucket
+    rounds = args.tp_rounds
+    rng = np.random.default_rng(0)
+
+    def images(n, hw):
+        return [
+            Image.fromarray(rng.integers(0, 255, (*hw, 3), dtype=np.uint8))
+            for _ in range(n)
+        ]
+
+    def parity(ref, out):
+        """(labels_equal, max_score_delta, max_box_delta_px) over batches."""
+        labels_ok = all(
+            [d["label"] for d in a] == [d["label"] for d in b]
+            for a, b in zip(ref, out)
+        )
+        s_max = b_max = 0.0
+        for a, b in zip(ref, out):
+            for da, db in zip(a, b):
+                s_max = max(s_max, abs(da["score"] - db["score"]))
+                b_max = max(
+                    b_max,
+                    float(np.max(np.abs(
+                        np.asarray(da["box"]) - np.asarray(db["box"])
+                    ))),
+                )
+        return labels_ok, s_max, b_max
+
+    results: dict = {"models": {}}
+    gates: dict[str, bool] = {}
+    headline_ips = None
+
+    for model_key, hf_name, hw in (
+        ("owlvit", "google/owlvit-base-patch32", (40, 40)),
+        ("rtdetr", "PekingU/rtdetr_v2_r18vd", (64, 64)),
+    ):
+        built = build_detector(hf_name)
+        rules = family_for(hf_name).tp_rules
+        imgs = images(bucket, hw)
+        single = InferenceEngine(built, threshold=0.0, batch_buckets=(bucket,))
+        single.warmup()
+        ref = single.detect(imgs)
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            single.detect(imgs)
+        ips_1 = bucket * rounds / (time.perf_counter() - t0)
+
+        per_tp: dict = {}
+        for tp in (2, 4):
+            if tp > n_dev:
+                continue
+            dp = max(1, min(2, n_dev // tp))
+            eng = InferenceEngine(
+                built, threshold=0.0, batch_buckets=(dp * bucket,),
+                mesh=make_mesh(dp=dp, tp=tp), tp_rules=rules,
+            )
+            eng.warmup()
+            out = eng.detect(imgs)
+            labels_ok, s_max, b_max = parity(ref, out)
+            batch = [imgs[i % len(imgs)] for i in range(dp * bucket)]
+            eng.detect(batch)  # settle
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                eng.detect(batch)
+            ips = dp * bucket * rounds / (time.perf_counter() - t0)
+            hbm = eng.metrics.snapshot()["hbm_per_device"]
+            mesh_ids = {str(d.id) for d in eng.devices()}
+            per_tp[f"tp{tp}"] = {
+                "dp": dp,
+                "labels_match": labels_ok,
+                "max_score_delta": round(s_max, 6),
+                "max_box_delta_px": round(b_max, 5),
+                "aggregate_ips": round(ips, 1),
+                "scaling_efficiency": round(ips / (ips_1 * dp * tp), 3),
+                "hbm_per_device": {k: hbm[k] for k in sorted(hbm)},
+                "hbm_devices_covered": mesh_ids <= set(hbm),
+            }
+            gates[f"{model_key}_tp{tp}_parity"] = (
+                labels_ok and s_max <= 1e-3 and b_max <= args.tp_box_tol_px
+            )
+            gates[f"{model_key}_tp{tp}_hbm_covered"] = mesh_ids <= set(hbm)
+            if model_key == "owlvit" and tp == 2:
+                headline_ips = ips
+        results["models"][model_key] = {
+            "tp1_ips": round(ips_1, 1), **per_tp,
+        }
+
+    # ---- per-param sharding ratio on a ViT-L-class tree (abstract) ----
+    from spotter_tpu.models.configs import (
+        OwlViTConfig, OwlViTTextConfig, OwlViTVisionConfig,
+    )
+    from spotter_tpu.models.owlvit import OwlViTDetector
+
+    cfg = OwlViTConfig(
+        text=OwlViTTextConfig(),
+        vision=OwlViTVisionConfig(
+            hidden_size=1024, intermediate_size=4096, num_hidden_layers=24,
+            num_attention_heads=16, image_size=224, patch_size=14,
+        ),
+        projection_dim=512,
+    )
+    module = OwlViTDetector(cfg)
+    shapes = jax.eval_shape(
+        lambda: module.init(
+            jax.random.PRNGKey(0), np.zeros((1, 224, 224, 3), np.float32),
+            np.zeros((4, 16), np.int32), np.ones((4, 16), np.int32),
+            method=OwlViTDetector.detect_with_text,
+        )
+    )["params"]
+    rep = sharding_report(shapes, make_mesh(dp=n_dev // 2, tp=2), OWLVIT_TP_RULES)
+    results["vitl_tp2_param_bytes_ratio"] = round(rep["per_device_ratio"], 3)
+    results["vitl_tp2_sharded_params"] = rep["sharded_params"]
+    gates["vitl_tp2_ratio_le_60pct"] = rep["per_device_ratio"] <= 0.60
+
+    # ---- open-vocab text-embedding cache: hit p50 vs miss p50 ----
+    built = build_detector("google/owlvit-base-patch32")
+    resolver = TextQueryResolver("bench-owlvit", built.text_encoder)
+    miss_ms: list[float] = []
+    hit_ms: list[float] = []
+    for i in range(args.tp_text_rounds):
+        vocab = [f"object {i} {j}" for j in range(8)]
+        t0 = time.perf_counter()
+        resolver.resolve(vocab)
+        miss_ms.append((time.perf_counter() - t0) * 1e3)
+        for _ in range(3):
+            t0 = time.perf_counter()
+            resolver.resolve(vocab)
+            hit_ms.append((time.perf_counter() - t0) * 1e3)
+    hit_p50 = float(np.median(hit_ms))
+    miss_p50 = float(np.median(miss_ms))
+    results["text_cache_hit_p50_ms"] = round(hit_p50, 4)
+    results["text_cache_miss_p50_ms"] = round(miss_p50, 3)
+    gates["text_cache_hit_faster_than_miss"] = hit_p50 < miss_p50
+
+    ok = all(gates.values())
+    owl = results["models"]["owlvit"]
+    print(
+        f"# tp-serve ({n_dev} virtual CPU devices, bucket {bucket}): "
+        f"owlvit tp1 {owl['tp1_ips']} img/s -> tp2 "
+        f"{owl.get('tp2', {}).get('aggregate_ips')} img/s; ViT-L tp2 "
+        f"per-device bytes {100 * results['vitl_tp2_param_bytes_ratio']:.1f}% "
+        f"of replicated; text cache hit p50 {hit_p50:.2f} ms vs miss "
+        f"{miss_p50:.1f} ms ({'PASS' if ok else 'FAIL'})",
+        file=sys.stderr,
+    )
+    record = {
+        "metric": (
+            f"tp-serve aggregate img/s (tiny OWL-ViT, dp×tp over {n_dev} "
+            f"virtual CPU devices, bucket {bucket}; parity tp2/tp4 vs tp1, "
+            f"ViT-L tp2 bytes ratio "
+            f"{results['vitl_tp2_param_bytes_ratio']}, text-cache hit "
+            f"{hit_p50:.2f}/miss {miss_p50:.0f} ms)"
+        ),
+        "value": round(headline_ips or 0.0, 1),
+        "unit": "images/sec",
+        "vs_baseline": None,
+        **results,
+        "gates": gates,
+        "pass": ok,
+    }
+    print(json.dumps(record))
+    return 0 if ok else 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="rtdetr_v2_r101vd")
@@ -2623,6 +2820,33 @@ def main() -> int:
         "this class of box; 50 ms cadence = 9%% of a core)",
     )
     parser.add_argument(
+        "--tp",
+        action="store_true",
+        help="run the tensor-parallel serving bench instead (CPU ok over "
+        "virtual devices, tiny models): tp=2/tp=4 parity vs tp=1 on tiny "
+        "OWL-ViT + tiny RT-DETR, scaling efficiency, per-device HBM, the "
+        "ViT-L-class tp=2 param-bytes ratio, and the open-vocab "
+        "text-embedding-cache hit/miss p50; exits non-zero when a gate "
+        "fails",
+    )
+    parser.add_argument(
+        "--tp-devices", type=int, default=8,
+        help="virtual host device count for --tp (dp=2×tp=2 and tp=4 both "
+        "need 8); forced into XLA_FLAGS when not already pinned",
+    )
+    parser.add_argument("--tp-bucket", type=int, default=4)
+    parser.add_argument("--tp-rounds", type=int, default=3)
+    parser.add_argument(
+        "--tp-box-tol-px", type=float, default=0.1,
+        help="max per-coordinate box delta (px) tolerated between tp=1 and "
+        "tp>1 detections of the tiny models",
+    )
+    parser.add_argument(
+        "--tp-text-rounds", type=int, default=8,
+        help="distinct vocabularies resolved for the text-cache hit/miss "
+        "p50 rows (each is 1 miss + 3 hits)",
+    )
+    parser.add_argument(
         "--multichip-serve",
         action="store_true",
         help="run the dp-sharded serving bench instead: aggregate img/s over "
@@ -2664,6 +2888,10 @@ def main() -> int:
         # before the jax import below: chaos_serve_bench sets the virtual
         # device count env first
         return chaos_serve_bench(args)
+    if args.tp:
+        # before the jax import below: tp_serve_bench sets the virtual
+        # device count env first
+        return tp_serve_bench(args)
 
     import os
 

@@ -46,6 +46,19 @@ def url_key(url: str) -> str:
     return f"url|{normalize_url(url)}"
 
 
+def queries_digest(queries) -> str:
+    """Order-insensitive digest of an open-vocabulary query set: the text
+    cache and the result-cache key suffix both key on sha256 over the SORTED
+    queries, so ["dog", "couch"] and ["couch", "dog"] are one vocabulary."""
+    joined = "\x1f".join(sorted(str(q) for q in queries))
+    return hashlib.sha256(joined.encode("utf-8")).hexdigest()
+
+
+def queries_key(model_name: str, queries) -> str:
+    """Text-embedding cache key: `model|sha256(sorted queries)` (ISSUE 13)."""
+    return f"{model_name}|{queries_digest(queries)}"
+
+
 def content_key(model_name: str, image_bytes: bytes, threshold: float) -> str:
     """The content-addressed key: model + sha256(bytes) + threshold bucket.
 

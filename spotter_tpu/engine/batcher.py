@@ -218,11 +218,16 @@ class MicroBatcher:
         # detect(images) signature, and the scheduler still gives them
         # slack ordering.
         try:
-            self._engine_takes_canvas = (
-                "canvas_hw" in inspect.signature(engine.detect).parameters
-            )
+            detect_params = inspect.signature(engine.detect).parameters
+            self._engine_takes_canvas = "canvas_hw" in detect_params
+            # open-vocab query sets (ISSUE 13): only the real engine's
+            # detect() speaks them; stub/synthetic engines keep the plain
+            # signature and never receive queried work (the detector layer
+            # rejects queries when the engine lacks a text encoder)
+            self._engine_takes_qset = "qset" in detect_params
         except (TypeError, ValueError):
             self._engine_takes_canvas = False
+            self._engine_takes_qset = False
         # key -> (primary future, waiter futures): one queue entry per key,
         # its result fanned to every waiter when the primary settles
         self._keyed: dict[str, tuple[asyncio.Future, list[asyncio.Future]]] = {}
@@ -351,6 +356,7 @@ class MicroBatcher:
         deadline: Optional[Deadline] = None,
         key: Optional[str] = None,
         cls: Optional[str] = None,
+        qset=None,
     ) -> list[dict]:
         """One image in, its detections out (awaits the batched device call).
 
@@ -373,6 +379,12 @@ class MicroBatcher:
         bulk entry may be revoked — its future fails `AdmitLimitError` —
         to make room for an slo arrival), and the deepest brownout rung
         sheds all bulk with `BrownoutShedError` (503).
+
+        `qset` (open vocabulary, ISSUE 13): the request's resolved
+        `QuerySet`. Its key is the item's batch-compatibility group — the
+        scheduler never mixes two query sets into one dispatch, and the
+        engine detects the pack against that vocabulary. None keeps the
+        closed-set path bit-identical.
         """
         metrics = self.engine.metrics
         if self.draining:
@@ -430,6 +442,7 @@ class MicroBatcher:
                 adm=adm,
                 cls=cls,
                 key=key,
+                qset=qset,
             ))
         except asyncio.QueueFull:
             if key is not None and self._keyed.get(key, (None,))[0] is fut:
@@ -645,6 +658,7 @@ class MicroBatcher:
         images: list[Image.Image],
         splits_left: int,
         canvas_hw: Optional[tuple[int, int]] = None,
+        qset=None,
     ) -> list:
         """Worker-thread engine call with poison bisect-retry (ISSUE 4).
 
@@ -664,9 +678,12 @@ class MicroBatcher:
         """
         try:
             faults.on_engine_batch(images)
+            kwargs = {}
             if canvas_hw is not None:
-                return list(self.engine.detect(images, canvas_hw=canvas_hw))
-            return list(self.engine.detect(images))
+                kwargs["canvas_hw"] = canvas_hw
+            if qset is not None:
+                kwargs["qset"] = qset
+            return list(self.engine.detect(images, **kwargs))
         except (FatalEngineError, TransientEngineError):
             raise
         except Exception as exc:
@@ -681,8 +698,10 @@ class MicroBatcher:
             self.engine.metrics.record_batch_retry()
             mid = len(images) // 2
             return self._detect_outcomes(
-                images[:mid], splits_left - 1, canvas_hw
-            ) + self._detect_outcomes(images[mid:], splits_left - 1, canvas_hw)
+                images[:mid], splits_left - 1, canvas_hw, qset
+            ) + self._detect_outcomes(
+                images[mid:], splits_left - 1, canvas_hw, qset
+            )
 
     async def _run_batch(self, plan: PackPlan) -> None:
         try:
@@ -692,6 +711,9 @@ class MicroBatcher:
                 return
             images = [item.image for item in batch]
             canvas_hw = plan.canvas_hw if self._engine_takes_canvas else None
+            # group isolation (ISSUE 13): the scheduler guarantees one query
+            # set per plan, so the pack's first item speaks for all of it
+            qset = batch[0].qset if self._engine_takes_qset else None
             # queue-wait attribution (ISSUE 7): each item's submit -> here.
             # slow_stage=queue_wait:<ms> injects before the dispatch stamp
             # so the injected latency lands inside the queue_wait span.
@@ -735,7 +757,7 @@ class MicroBatcher:
             try:
                 detect = asyncio.to_thread(
                     self._detect_outcomes, images, self.poison_max_splits,
-                    canvas_hw,
+                    canvas_hw, qset,
                 )
                 if self.batch_timeout_s is not None:
                     outcomes = await asyncio.wait_for(detect, self.batch_timeout_s)

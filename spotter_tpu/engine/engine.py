@@ -80,6 +80,10 @@ class BuiltDetector:
     apply_kwargs: dict = field(default_factory=dict)
     # DETR-style models consume the preprocess pixel mask (padded buckets)
     needs_mask: bool = False
+    # Open-vocabulary runtime path (ISSUE 13): list[str] queries ->
+    # normalized (Q, proj) float32 embeddings through the model's text
+    # tower. None = closed-set family; the engine then rejects qset detects.
+    text_encoder: Optional[Callable] = None
 
 
 def default_batch_buckets(max_batch: int = 8) -> tuple[int, ...]:
@@ -190,6 +194,47 @@ class InferenceEngine:
             donate_argnums=(1,) if (donate_pixels and self.device_preprocess) else (),
         )
 
+        # Open-vocabulary forward (ISSUE 13): same staging substrate, but the
+        # query matrix is an ARGUMENT instead of a baked jit constant, so one
+        # engine serves arbitrary vocabularies. The query count is padded to
+        # a bucket (caching/text_cache.py QUERY_PAD) with a validity mask, so
+        # the compile count is bounded by pad multiples, not vocabularies.
+        self._forward_q = None
+        if built.text_encoder is not None:
+
+            def apply_post_q(params, pixels, masks, target_sizes,
+                             query_embeds, query_mask):
+                args = (pixels, masks) if built.needs_mask else (pixels,)
+                out = built.module.apply(
+                    {"params": params}, *args,
+                    query_embeds=query_embeds, query_mask=query_mask,
+                )
+                return sigmoid_max_postprocess(
+                    out["logits"], out["pred_boxes"], target_sizes
+                )
+
+            if self.device_preprocess:
+                spec_q = built.preprocess_spec
+
+                def forward_q(params, pixels_u8, valid_hw, target_sizes,
+                              query_embeds, query_mask):
+                    pixels, masks = device_rescale_normalize(
+                        pixels_u8, valid_hw, spec_q
+                    )
+                    return apply_post_q(
+                        params, pixels, masks, target_sizes,
+                        query_embeds, query_mask,
+                    )
+
+            else:
+                forward_q = apply_post_q
+            self._forward_q = jax.jit(
+                forward_q,
+                donate_argnums=(1,)
+                if (donate_pixels and self.device_preprocess)
+                else (),
+            )
+
     def _place(self, mesh, device, batch_buckets: Sequence[int]) -> None:
         """Bind params + input sharding + bucket ladder to a topology.
 
@@ -199,8 +244,21 @@ class InferenceEngine:
         """
         self.mesh = mesh
         if mesh is not None:
-            from spotter_tpu.parallel.sharding import data_sharding, shard_params
+            from spotter_tpu.parallel.sharding import (
+                check_rules_cover,
+                data_sharding,
+                shard_params,
+            )
 
+            if int(dict(mesh.shape).get("tp", 1)) > 1 and self.tp_rules:
+                # fail-loud (ISSUE 13): a TP rule matching nothing means the
+                # param tree drifted from the family's rule set — at real
+                # model scale those weights would silently replicate and
+                # blow the per-chip HBM ceiling tp exists to stay under
+                check_rules_cover(
+                    self.built.params, self.tp_rules,
+                    family=self.built.model_name,
+                )
             dp = mesh.shape["dp"]
             # every bucket must split evenly across dp shards: round UP so the
             # configured max batch capacity is kept, never shrunk
@@ -233,6 +291,14 @@ class InferenceEngine:
     def dp(self) -> int:
         """Data-parallel width the serving batch is sharded over (1 = single chip)."""
         return int(self.mesh.shape["dp"]) if self.mesh is not None else 1
+
+    @property
+    def tp(self) -> int:
+        """Tensor-parallel width the params are split over (1 = whole params
+        on every chip)."""
+        if self.mesh is None:
+            return 1
+        return int(dict(self.mesh.shape).get("tp", 1))
 
     def devices(self) -> list:
         """The devices this engine currently places work on."""
@@ -325,18 +391,24 @@ class InferenceEngine:
     def _current_source(self) -> str:
         return getattr(self._compile_src, "value", None) or "traffic"
 
-    def _shape_key(self, batch: int, h: int, w: int) -> str:
-        return (
-            f"{'u8' if self.device_preprocess else 'f32'}:{batch}x{h}x{w}"
-        )
+    def _shape_key(self, batch: int, h: int, w: int, qset=None) -> str:
+        base = f"{'u8' if self.device_preprocess else 'f32'}:{batch}x{h}x{w}"
+        if qset is not None:
+            # the open-vocab forward is a distinct program per padded query
+            # count — the compile ledger must not conflate it with the
+            # closed-set program of the same pixel shape
+            base += f":q{qset.embeds.shape[0]}"
+        return base
 
-    def _flops_of(self, abstract_args) -> Optional[float]:
+    def _flops_of(self, abstract_args, fn=None) -> Optional[float]:
         """FLOPs of the compiled program for one input shape, from XLA's
         HLO cost analysis on the lowered (pre-compile) module — a re-trace,
         not a re-compile, so it is cheap enough to run once per shape
         inline. Called through `PerfLedger.flops_for`, which caches the
-        result (failures included) per shape key."""
-        lo = self._forward.lower(self.params, *abstract_args)
+        result (failures included) per shape key. `fn` selects the program
+        (default the closed-set forward; the open-vocab dispatch passes
+        `_forward_q`)."""
+        lo = (fn or self._forward).lower(self.params, *abstract_args)
         ca = lo.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
@@ -409,10 +481,21 @@ class InferenceEngine:
             # monolithic device_put that would hit the same dead chip.
             return jax.device_put(arr, self._in_sharding)
 
+    def _put_rep(self, arr: np.ndarray):
+        """Host array -> device(s), REPLICATED. The open-vocab query matrix
+        must land whole on every chip (its leading axis is queries, not
+        batch — `_put`'s dp sharding would split the vocabulary)."""
+        if self.mesh is None:
+            return jax.device_put(arr, self.device)
+        from spotter_tpu.parallel.sharding import replicated
+
+        return jax.device_put(arr, replicated(self.mesh))
+
     def detect(
         self,
         images: list[Image.Image],
         canvas_hw: Optional[tuple[int, int]] = None,
+        qset=None,
     ) -> list[list[dict]]:
         """PIL images -> per-image lists of {"label", "score", "box"} dicts.
 
@@ -443,7 +526,18 @@ class InferenceEngine:
         raises `FatalEngineError` for the batcher's degraded-rebuild /
         controlled-exit path; plain model errors propagate unchanged so the
         batcher's poison bisect can isolate them per image.
+
+        `qset` (open vocabulary, ISSUE 13): a `caching.text_cache.QuerySet`
+        — the whole call detects against ITS vocabulary through the
+        query-argument forward (`_forward_q`), labels mapped through
+        `qset.id2label`. None (the default, and always for closed-set
+        families) keeps the baked-constant forward bit-identical.
         """
+        if qset is not None and self._forward_q is None:
+            raise ValueError(
+                f"{self.built.model_name} is a closed-set family: it has no "
+                f"text encoder, so per-request `queries` are unsupported"
+            )
         if not self._rebuild_gate.is_set():
             # a degraded rebuild is swapping placement under us: wait it out
             # rather than racing half-moved params (bounded by the watchdog
@@ -455,34 +549,38 @@ class InferenceEngine:
         pending = None  # (dispatched_item, chunk_images)
         for chunk in chunks:
             try:
-                host = self._stage_host(chunk, canvas_hw)
+                host = self._stage_host(chunk, canvas_hw, qset)
                 with self._h2d_lock:
                     dispatched = self._dispatch(self._put_staged(host))
             except Exception as exc:
                 # keep result order: finish the older in-flight chunk first,
                 # then recover (or fail) this one
                 if pending is not None:
-                    results.extend(self._finish_or_recover(*pending, canvas_hw))
+                    results.extend(
+                        self._finish_or_recover(*pending, canvas_hw, qset)
+                    )
                     pending = None
-                results.extend(self._recover_chunk(chunk, exc, canvas_hw))
+                results.extend(self._recover_chunk(chunk, exc, canvas_hw, qset))
                 continue
             if pending is not None:
-                results.extend(self._finish_or_recover(*pending, canvas_hw))
+                results.extend(self._finish_or_recover(*pending, canvas_hw, qset))
             pending = (dispatched, chunk)
         if pending is not None:
-            results.extend(self._finish_or_recover(*pending, canvas_hw))
+            results.extend(self._finish_or_recover(*pending, canvas_hw, qset))
         return results
 
     def _finish_or_recover(
-        self, dispatched_item, images: list[Image.Image], canvas_hw=None
+        self, dispatched_item, images: list[Image.Image], canvas_hw=None,
+        qset=None,
     ):
         try:
             return self._finish(dispatched_item)
         except Exception as exc:
-            return self._recover_chunk(images, exc, canvas_hw)
+            return self._recover_chunk(images, exc, canvas_hw, qset)
 
     def _recover_chunk(
-        self, images: list[Image.Image], exc: Exception, canvas_hw=None
+        self, images: list[Image.Image], exc: Exception, canvas_hw=None,
+        qset=None,
     ) -> list[list[dict]]:
         """Classify a failed chunk and recover when the taxonomy allows it."""
         kind = classify_engine_exception(exc)
@@ -499,34 +597,34 @@ class InferenceEngine:
                 # OOM-downgrade cost, not organic traffic churn
                 with self._compile_source("oom_downgrade"):
                     if len(images) <= 1:
-                        return self._detect_chunk(images, canvas_hw)
+                        return self._detect_chunk(images, canvas_hw, qset)
                     mid = (len(images) + 1) // 2
                     return self._detect_chunk(
-                        images[:mid], canvas_hw
-                    ) + self._detect_chunk(images[mid:], canvas_hw)
+                        images[:mid], canvas_hw, qset
+                    ) + self._detect_chunk(images[mid:], canvas_hw, qset)
             except Exception as retry_exc:
                 raise as_typed(retry_exc) from retry_exc
         raise exc
 
     def _detect_chunk(
-        self, images: list[Image.Image], canvas_hw=None
+        self, images: list[Image.Image], canvas_hw=None, qset=None
     ) -> list[list[dict]]:
         """Serial stage -> dispatch -> fetch for one chunk (<= max bucket)."""
-        host = self._stage_host(images, canvas_hw)
+        host = self._stage_host(images, canvas_hw, qset)
         with self._h2d_lock:
             dispatched = self._dispatch(self._put_staged(host))
         return self._finish(dispatched)
 
-    def _stage(self, images: list[Image.Image], canvas_hw=None):
+    def _stage(self, images: list[Image.Image], canvas_hw=None, qset=None):
         """Host staging: decode/preprocess, pad to the bucket, device_put.
 
         Composition of `_stage_host` (decode half, runs outside the H2D
         lock) and `_put_staged` (upload half) for callers that don't split
         them.
         """
-        return self._put_staged(self._stage_host(images, canvas_hw))
+        return self._put_staged(self._stage_host(images, canvas_hw, qset))
 
-    def _stage_host(self, images: list[Image.Image], canvas_hw=None):
+    def _stage_host(self, images: list[Image.Image], canvas_hw=None, qset=None):
         """Decode/preprocess half of staging: everything before the H2D.
 
         Device-preprocess mode produces uint8 pixels + a (B, 2) valid-region
@@ -573,10 +671,10 @@ class InferenceEngine:
                 sizes = np.concatenate([sizes, np.ones((pad, 2), sizes.dtype)])
             host_arrays = (pixels, masks, sizes)
         return host_arrays, n, t0, time.monotonic(), self._perf_meta(
-            images, pixels, n, spec
-        )
+            images, pixels, n, spec, qset
+        ), qset
 
-    def _perf_meta(self, images, pixels, n: int, spec) -> Optional[dict]:
+    def _perf_meta(self, images, pixels, n: int, spec, qset=None) -> Optional[dict]:
         """Per-dispatch efficiency accounting inputs (ISSUE 10): the shape
         key the compile ledger tracks, the padded pixel volume the program
         pays FLOPs for, and the valid pixel volume that carries signal
@@ -597,7 +695,7 @@ class InferenceEngine:
             # fixed specs fill the canvas; pad_square approximately does
             valid_px = n * ch * cw
         return {
-            "shape": self._shape_key(b, ch, cw),
+            "shape": self._shape_key(b, ch, cw, qset),
             "padded_px": padded_px,
             "valid_px": min(valid_px, padded_px),
         }
@@ -607,17 +705,24 @@ class InferenceEngine:
         under a mesh) plus the H2D accounting. Callers hold `_h2d_lock`
         across this + `_dispatch` so uploads stay ordered while `_finish`
         (D2H) proceeds concurrently."""
-        host_arrays, n, t0, t_decode, meta = host_item
+        host_arrays, n, t0, t_decode, meta, qset = host_item
         faults.sleep_stage(obs.H2D)  # slow_stage=h2d:<ms> injection
         staged = tuple(self._put(a) for a in host_arrays)
+        if qset is not None:
+            # the query matrix replicates (its leading axis is queries, not
+            # batch); tiny next to the pixel tensors, so the H2D accounting
+            # ignores it
+            staged = staged + (
+                self._put_rep(qset.embeds), self._put_rep(qset.mask),
+            )
         self.metrics.record_h2d_bytes(sum(a.nbytes for a in host_arrays), n)
         self.metrics.set_decode_queue_depth(self._decode_pool.queue_depth())
-        return staged, n, t0, t_decode, time.monotonic(), meta
+        return staged, n, t0, t_decode, time.monotonic(), meta, qset
 
     def _dispatch(self, staged_item):
         """Async-dispatch the compiled forward; no host blocking (except a
         novel shape's compile, which the compile ledger times — ISSUE 10)."""
-        staged, n, t0, t_decode, t_pre, meta = staged_item
+        staged, n, t0, t_decode, t_pre, meta, qset = staged_item
         # fault seam: a dead-shard or device-OOM injection raises here with
         # the same status markers the real runtime would embed
         faults.on_engine_dispatch(n, [d.id for d in self.devices()])
@@ -630,7 +735,10 @@ class InferenceEngine:
                 jax.ShapeDtypeStruct(a.shape, a.dtype) for a in staged
             )
         t_c = time.monotonic()
-        outputs = self._forward(self.params, *staged)
+        if qset is not None:
+            outputs = self._forward_q(self.params, *staged)
+        else:
+            outputs = self._forward(self.params, *staged)
         t_disp = time.monotonic()
         if novel:
             # first call of a shape blocks on trace+compile; its wall time
@@ -639,25 +747,29 @@ class InferenceEngine:
                 meta["shape"], t_disp - t_c, self._current_source()
             )
         if meta is not None:
+            fwd = self._forward_q if qset is not None else self._forward
             meta["flops"] = perf.flops_for(
-                meta["shape"], lambda a=absargs: self._flops_of(a)
+                meta["shape"], lambda a=absargs, f=fwd: self._flops_of(a, f)
             )
         # queue the D2H copies now: they start the moment compute finishes,
         # overlapping the next chunk's staging instead of its fetch
         for arr in outputs:
             arr.copy_to_host_async()
-        return outputs, n, t0, t_decode, t_pre, t_disp, meta
+        return outputs, n, t0, t_decode, t_pre, t_disp, meta, qset
 
     def _finish(self, dispatched_item) -> list[list[dict]]:
         """Block on the fetch, threshold on host, record metrics."""
-        outputs, n, t0, t_decode, t_pre, t_disp, meta = dispatched_item
+        outputs, n, t0, t_decode, t_pre, t_disp, meta, qset = dispatched_item
         faults.sleep_stage(obs.DEVICE)  # slow_stage=device:<ms> injection
         scores, labels, boxes = jax.device_get(outputs)
         t_dev = time.monotonic()
         faults.sleep_stage(obs.POSTPROCESS)
+        # open-vocab dispatches label against THEIR vocabulary (padded query
+        # slots carry NEG_INF logits, so the argmax never lands on one)
+        id2label = qset.id2label if qset is not None else self.built.id2label
         out = [
             to_detections(
-                scores[j], labels[j], boxes[j], self.built.id2label, self.threshold
+                scores[j], labels[j], boxes[j], id2label, self.threshold
             )
             for j in range(n)
         ]

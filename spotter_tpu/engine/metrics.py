@@ -38,6 +38,14 @@ STAGE_BUCKETS_MS = (
 REPLICA_ID_ENV = "SPOTTER_TPU_REPLICA_ID"
 
 
+def _median(ring) -> float | None:
+    """Median of a sample deque, None when empty (prom skips None)."""
+    if not ring:
+        return None
+    vals = sorted(ring)
+    return vals[len(vals) // 2]
+
+
 def default_replica_id() -> str:
     """Stable-per-process replica identity: the env override wins (fleet
     operators can pin pod names), else host:pid — unique across a fleet
@@ -160,6 +168,14 @@ class Metrics:
         self._wire_requests_total = 0
         self._wire_frame_responses_total = 0
         self._wire_json_responses_total = 0
+        # Open-vocabulary text-embedding cache (ISSUE 13): hit/miss counts
+        # and resolve wall times — the "repeated vocabularies cost one
+        # encode" claim's measured substrate (hit p50 must sit far under
+        # miss p50, which carries the text-tower forward).
+        self._text_cache_hits_total = 0
+        self._text_cache_misses_total = 0
+        self._text_hit_ms: deque[float] = deque(maxlen=window)
+        self._text_miss_ms: deque[float] = deque(maxlen=window)
         # Device-efficiency plane (ISSUE 10): MFU/duty-cycle accounting,
         # compile ledger, HBM gauges, and SLO burn-rate. The ledger is
         # stdlib-only and owns its own lock; the engine feeds dispatches
@@ -415,6 +431,20 @@ class Metrics:
             if ragged:
                 self._ragged_packs_total += 1
 
+    def record_text_cache(self, hit: bool, resolve_ms: float | None) -> None:
+        """One open-vocab query-set resolve (ISSUE 13): cache outcome plus
+        the resolve wall time (a miss's time includes the text-tower
+        encode; a hit's is the dict lookup)."""
+        with self._lock:
+            if hit:
+                self._text_cache_hits_total += 1
+                if resolve_ms is not None:
+                    self._text_hit_ms.append(resolve_ms)
+            else:
+                self._text_cache_misses_total += 1
+                if resolve_ms is not None:
+                    self._text_miss_ms.append(resolve_ms)
+
     def set_cache_size(self, entries: int, nbytes: int) -> None:
         with self._lock:
             self._cache_entries = entries
@@ -564,6 +594,10 @@ class Metrics:
                 "coalesced_submits_total": self._coalesced_submits_total,
                 "cache_entries": self._cache_entries,
                 "cache_bytes": self._cache_bytes,
+                "text_cache_hits_total": self._text_cache_hits_total,
+                "text_cache_misses_total": self._text_cache_misses_total,
+                "text_cache_hit_ms_p50": _median(self._text_hit_ms),
+                "text_cache_miss_ms_p50": _median(self._text_miss_ms),
                 "wire_bytes_in_total": self._wire_bytes_in_total,
                 "wire_bytes_out_total": self._wire_bytes_out_total,
                 "wire_requests_total": self._wire_requests_total,

@@ -106,6 +106,15 @@ class QueueItem:
     cls: str = SLO
     key: Optional[str] = None
     dims: Optional[tuple[int, int]] = field(default=None, compare=False)
+    # Open-vocabulary query set (ISSUE 13): a caching.text_cache.QuerySet.
+    # Its `key` is this item's batch-compatibility GROUP — the engine's
+    # open-vocab program is specialized per query set, so a pack must never
+    # mix two groups (None = the closed-set default group).
+    qset: Optional[object] = field(default=None, compare=False)
+
+    @property
+    def group(self) -> Optional[str]:
+        return self.qset.key if self.qset is not None else None
 
 
 @dataclass
@@ -245,8 +254,40 @@ class Scheduler:
         buffer can: a dispatch costs padded_batch x canvas_area FLOPs
         whether its slots are full or not (`buckets` documents the ladder
         the engine pads to), so runt packs are wasted calls.
+
+        Query-group isolation (ISSUE 13): the engine's open-vocab program is
+        specialized per query set, so a pack only ever draws from ONE
+        `QueueItem.group`. The group is the leader's (queue head under FIFO,
+        highest-priority item under ragged); other groups stay pending and
+        lead the next plan — the delay window bounds their extra wait
+        exactly like any leftover. With a single group in the buffer (the
+        closed-set default: every group None) this path is untaken and the
+        plan is bit-identical to the pre-ISSUE-13 policy.
         """
         target = max(1, target)
+        if len({it.group for it in pending}) > 1:
+            now = time.monotonic() if now is None else now
+            if self.fifo:
+                group = pending[0].group
+            else:
+                group = min(
+                    pending, key=lambda it: self.priority_key(it, now)
+                ).group
+            selected = [it for it in pending if it.group == group]
+            plan = self._plan_from(selected, target, now, buckets)
+            chosen = {id(it) for it in plan.items}
+            pending[:] = [it for it in pending if id(it) not in chosen]
+            return plan
+        return self._plan_from(pending, target, now, buckets)
+
+    def _plan_from(
+        self,
+        pending: list[QueueItem],
+        target: int,
+        now: Optional[float] = None,
+        buckets: Optional[Sequence[int]] = None,
+    ) -> PackPlan:
+        """The single-group policy body (see `plan`); mutates `pending`."""
         if self.fifo:
             pack = pending[:target]
             del pending[: len(pack)]

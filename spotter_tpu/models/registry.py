@@ -3,9 +3,15 @@
 Plays the role of `AutoModelForObjectDetection.from_pretrained(MODEL_NAME)` in
 the reference (serve.py:203-204). Families register themselves here; lookup is
 by HF repo-name substring so the same MODEL_NAME env values keep working.
+
+Each family also carries its tensor-parallel rule set (`tp_rules`, a
+parallel/sharding.py Rules tuple): the regexes that split THIS family's
+attention/MLP weights over the "tp" mesh axis. The serving bootstrap reads
+the rules from here instead of assuming one architecture, so `tp=2` on an
+OWL-ViT deployment shards the CLIP towers, not a hand-written RT-DETR list.
 """
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable
 
 MODEL_REGISTRY: dict[str, "ModelFamily"] = {}
@@ -18,14 +24,19 @@ class ModelFamily:
     name: str
     matches: tuple[str, ...]  # substrings of MODEL_NAME that select this family
     build: Callable  # (model_name) -> BuiltDetector
+    # (regex, PartitionSpec) pairs splitting this family's weights over the
+    # "tp" mesh axis (parallel/sharding.py); empty = the family serves
+    # replicated-only (tp>1 buys nothing but costs nothing either)
+    tp_rules: tuple = field(default=())
 
 
 def register(family: ModelFamily) -> None:
     MODEL_REGISTRY[family.name] = family
 
 
-def build_detector(model_name: str):
-    """Resolve MODEL_NAME to a built detector (module, params, specs)."""
+def family_for(model_name: str) -> ModelFamily:
+    """Resolve MODEL_NAME to its registered family (substring match, the
+    registration-order precedence the zoo relies on)."""
     # Lazy: zoo pulls in the engine (jax/PIL); config-only consumers of
     # spotter_tpu.models must not pay that import.
     from spotter_tpu.models import zoo  # noqa: F401  (self-registers families)
@@ -33,8 +44,13 @@ def build_detector(model_name: str):
     key = model_name.lower()
     for family in MODEL_REGISTRY.values():
         if any(m in key for m in family.matches):
-            return family.build(model_name)
+            return family
     raise ValueError(
         f"MODEL_NAME '{model_name}' does not match any registered family: "
         f"{[f.matches for f in MODEL_REGISTRY.values()]}"
     )
+
+
+def build_detector(model_name: str):
+    """Resolve MODEL_NAME to a built detector (module, params, specs)."""
+    return family_for(model_name).build(model_name)

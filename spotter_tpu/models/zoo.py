@@ -253,19 +253,35 @@ def owlvit_query_labels() -> list[str]:
     return list(AMENITIES_MAPPING)
 
 
+def _tiny_tokenize(prompts: list[str], vocab_size: int, t: int):
+    """Deterministic pseudo-tokenizer for the tiny (no-torch) OWL-ViT: each
+    prompt hashes to a stable token sequence, so runtime `encode_text` of the
+    same query string is reproducible across processes (the text-embedding
+    cache key contract) without an HF tokenizer in the image."""
+    import hashlib
+
+    rows = []
+    for p in prompts:
+        seed = int.from_bytes(hashlib.sha256(p.encode()).digest()[:8], "little")
+        rng = np.random.default_rng(seed)
+        rows.append(rng.integers(1, vocab_size, (t,)))
+    ids = np.stack(rows).astype(np.int32)
+    return ids, np.ones_like(ids)
+
+
 def _build_owlvit(model_name: str) -> BuiltDetector:
     labels = owlvit_query_labels()
     prompts = [f"a photo of a {label}" for label in labels]
-    if os.environ.get(TINY_ENV):
+    tiny = bool(os.environ.get(TINY_ENV))
+    if tiny:
         cfg = tiny_owlvit_config()
         module = OwlViTDetector(
             cfg, dtype=compute_dtype(), vision_dtype=backbone_dtype()
         )
         spec = PreprocessSpec(mode="fixed", size=(32, 32), mean=CLIP_MEAN, std=CLIP_STD)
-        rng = np.random.default_rng(0)
-        t = cfg.text.max_position_embeddings
-        ids = rng.integers(1, cfg.text.vocab_size, (len(prompts), t)).astype(np.int32)
-        mask = np.ones_like(ids)
+        ids, mask = _tiny_tokenize(
+            prompts, cfg.text.vocab_size, cfg.text.max_position_embeddings
+        )
         params = module.init(
             jax.random.PRNGKey(0),
             np.zeros((1, 32, 32, 3), np.float32),
@@ -291,6 +307,31 @@ def _build_owlvit(model_name: str) -> BuiltDetector:
     query_embeds = np.asarray(
         module.apply({"params": params}, ids, mask, method=OwlViTDetector.encode_text)
     )
+
+    def encode_text(queries: list[str]) -> np.ndarray:
+        """Runtime text encoder for the open-vocabulary /detect path: query
+        strings -> normalized (Q, proj) embeddings, same prompt template and
+        text tower as the build-time vocabulary. Callers cache the result
+        (caching/text_cache.py) so a repeated vocabulary costs one encode."""
+        q_prompts = [f"a photo of a {q}" for q in queries]
+        if tiny:
+            q_ids, q_mask = _tiny_tokenize(
+                q_prompts, cfg.text.vocab_size, cfg.text.max_position_embeddings
+            )
+        else:
+            from spotter_tpu.convert.loader import owlvit_tokenize  # lazy
+
+            q_ids, q_mask = owlvit_tokenize(
+                model_name, q_prompts, cfg.text.max_position_embeddings
+            )
+        return np.asarray(
+            module.apply(
+                {"params": params}, q_ids, q_mask,
+                method=OwlViTDetector.encode_text,
+            ),
+            np.float32,
+        )
+
     return BuiltDetector(
         model_name=model_name,
         module=module,
@@ -300,6 +341,7 @@ def _build_owlvit(model_name: str) -> BuiltDetector:
         id2label=dict(enumerate(labels)),
         num_top_queries=len(labels),
         apply_kwargs={"query_embeds": query_embeds},
+        text_encoder=encode_text,
     )
 
 
@@ -474,6 +516,18 @@ def _build_dab_detr(model_name: str) -> BuiltDetector:
     )
 
 
+# Per-family TP rule sets (ISSUE 13): the registry is where the serving
+# bootstrap looks them up, so tp>1 shards the weights of the family actually
+# being served. All current families speak the shared layers.py transformer
+# vocabulary (fc1/fc2, q/k/v/out_proj); OWL-ViT keeps its own name for the
+# towers-specific documentation in sharding.py.
+from spotter_tpu.parallel.sharding import (  # noqa: E402  (after model imports)
+    OWLVIT_TP_RULES,
+    RTDETR_TP_RULES,
+    TRANSFORMER_TP_RULES,
+    VIT_TP_RULES,
+)
+
 register(
     # must precede the plain-detr family: "conditional-detr-resnet-50"
     # also contains the "detr-resnet" substring
@@ -481,12 +535,14 @@ register(
         name="conditional_detr",
         matches=("conditional-detr", "conditional_detr"),
         build=_build_conditional_detr,
+        tp_rules=tuple(TRANSFORMER_TP_RULES),
     )
 )
 register(
     # must precede plain-detr: "dab-detr-resnet-50" contains "detr-resnet"
     ModelFamily(
-        name="dab_detr", matches=("dab-detr", "dab_detr"), build=_build_dab_detr
+        name="dab_detr", matches=("dab-detr", "dab_detr"), build=_build_dab_detr,
+        tp_rules=tuple(TRANSFORMER_TP_RULES),
     )
 )
 register(
@@ -494,19 +550,27 @@ register(
         name="deformable_detr",
         matches=("deformable-detr", "deformable_detr"),
         build=_build_deformable_detr,
+        tp_rules=tuple(TRANSFORMER_TP_RULES),
     )
 )
 register(
-    ModelFamily(name="rtdetr", matches=("rtdetr", "rt_detr", "rt-detr"), build=_build_rtdetr)
+    ModelFamily(
+        name="rtdetr", matches=("rtdetr", "rt_detr", "rt-detr"),
+        build=_build_rtdetr, tp_rules=tuple(RTDETR_TP_RULES),
+    )
 )
 register(
     ModelFamily(
         name="owlvit",  # OWL-ViT and OWLv2 (same architecture + objectness head)
         matches=("owlvit", "owl-vit", "owl_vit", "owlv2", "owl-v2", "owl_v2"),
         build=_build_owlvit,
+        tp_rules=tuple(OWLVIT_TP_RULES),
     )
 )
-register(ModelFamily(name="yolos", matches=("yolos",), build=_build_yolos))
+register(ModelFamily(
+    name="yolos", matches=("yolos",), build=_build_yolos,
+    tp_rules=tuple(VIT_TP_RULES),
+))
 register(
     # plain DETR (+ Table-Transformer, a pre-norm DETR with identical keys);
     # matched AFTER rtdetr so "rtdetr*" names never land here
@@ -514,5 +578,6 @@ register(
         name="detr",
         matches=("detr-resnet", "detr_resnet", "table-transformer", "table_transformer"),
         build=_build_detr,
+        tp_rules=tuple(TRANSFORMER_TP_RULES),
     )
 )

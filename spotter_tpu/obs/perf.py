@@ -437,6 +437,18 @@ class PerfLedger:
                 "limit_bytes": int(stats.get("bytes_limit", 0) or 0),
             }
 
+    def ensure_hbm_device(self, device: str) -> None:
+        """Guarantee a `hbm_per_device` row for `device` WITHOUT overwriting
+        a last-known reading: a device whose memory_stats() is unavailable
+        (CPU backends, a transient poll failure) still shows up — zeroed —
+        so a dp×tp mesh's full device set is auditable in /metrics even on
+        the virtual CPU mesh the tp gates run on (ISSUE 13)."""
+        with self._lock:
+            self._hbm.setdefault(
+                str(device),
+                {"bytes_in_use": 0, "peak_bytes": 0, "limit_bytes": 0},
+            )
+
     # -- views ------------------------------------------------------------
 
     def _window_sums(self, now: float) -> tuple[float, float, float, float]:
@@ -579,4 +591,9 @@ def sample_hbm_once(devices_fn, ledger: PerfLedger) -> int:
         if stats:
             ledger.set_hbm(str(getattr(d, "id", i)), stats)
             reported += 1
+        else:
+            # presence without a reading: every polled device keeps a row
+            # (zeroed until it reports), so per-device HBM is auditable for
+            # the whole dp×tp device set even where stats are unavailable
+            ledger.ensure_hbm_device(str(getattr(d, "id", i)))
     return reported

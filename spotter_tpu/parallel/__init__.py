@@ -12,12 +12,20 @@ the reference's pods are driven by MODEL_NAME env (serve.py:199-205).
 from spotter_tpu.parallel.mesh import local_mesh, make_mesh
 from spotter_tpu.parallel.multihost import initialize_multihost, multihost_env_summary
 from spotter_tpu.parallel.sharding import (
+    OWLVIT_TP_RULES,
     RTDETR_TP_RULES,
+    TRANSFORMER_TP_RULES,
+    VIT_TP_RULES,
+    check_rules_cover,
     data_sharding,
+    format_sharding_report,
+    match_partition_rules,
     param_shardings,
     replicated,
     shard_params,
+    sharding_report,
     spec_for_path,
+    unmatched_rules,
 )
 
 __all__ = [
@@ -25,10 +33,18 @@ __all__ = [
     "make_mesh",
     "initialize_multihost",
     "multihost_env_summary",
+    "OWLVIT_TP_RULES",
     "RTDETR_TP_RULES",
+    "TRANSFORMER_TP_RULES",
+    "VIT_TP_RULES",
+    "check_rules_cover",
     "data_sharding",
+    "format_sharding_report",
+    "match_partition_rules",
     "param_shardings",
     "replicated",
     "shard_params",
+    "sharding_report",
     "spec_for_path",
+    "unmatched_rules",
 ]

@@ -21,21 +21,35 @@ def make_mesh(
     dp: Optional[int] = None,
     tp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    source: str = "",
 ) -> Mesh:
     """Build a ("dp", "tp") mesh over `devices` (default: all of them).
 
     `dp` defaults to n_devices // tp, so `make_mesh()` is the whole machine
     data-parallel and `make_mesh(tp=4)` splits each DP group 4-way.
+
+    `source` names the knob that produced (dp, tp) — e.g.
+    "SPOTTER_TPU_MESH" or "SPOTTER_TPU_SERVE_DP x SPOTTER_TPU_SERVE_TP" —
+    so a mis-sized spec fails at construction with the knob in the message
+    instead of as a deep XLA placement error.
     """
     devs = list(devices) if devices is not None else list(jax.devices())
+    via = f" (set via {source})" if source else ""
     if tp <= 0:
-        raise ValueError(f"tp must be positive, got {tp}")
+        raise ValueError(f"tp must be positive, got {tp}{via}")
+    if dp is not None and dp <= 0:
+        raise ValueError(f"dp must be positive, got {dp}{via}")
     if dp is None:
         if len(devs) % tp:
-            raise ValueError(f"{len(devs)} devices not divisible by tp={tp}")
+            raise ValueError(
+                f"{len(devs)} available devices not divisible by tp={tp}{via}"
+            )
         dp = len(devs) // tp
     if dp * tp > len(devs):
-        raise ValueError(f"dp*tp = {dp * tp} exceeds {len(devs)} devices")
+        raise ValueError(
+            f"dp={dp} x tp={tp} needs {dp * tp} devices but only "
+            f"{len(devs)} are available{via}"
+        )
     grid = np.asarray(devs[: dp * tp]).reshape(dp, tp)
     return Mesh(grid, ("dp", "tp"))
 

@@ -10,24 +10,55 @@ paths (e.g. "decoder_layer0/fc1/kernel"); first match wins, no match means
 fully replicated. Megatron-style TP: up-projections (fc1, q/k/v) split the
 output feature axis, down-projections (fc2, out_proj) split the input axis,
 so each FFN/attention block needs one psum, placed by XLA.
+
+Per-family rule sets live on `ModelFamily.tp_rules` (models/registry.py) so
+the serving bootstrap picks the set matching MODEL_NAME instead of assuming
+one architecture. `match_partition_rules` (the SNIPPETS [3] shape) resolves
+a whole tree of specs; `sharding_report` explains the result — param path ->
+spec -> per-device bytes — and `check_rules_cover` fails LOUD on a rule that
+matches nothing (a silently-dead rule means a renamed layer quietly serves
+fully replicated, which at ViT-L scale is exactly the HBM overflow tp=2
+exists to prevent).
 """
 
 import re
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Rules = Sequence[tuple[str, P]]
 
-# RT-DETR family (models/rtdetr.py param tree). Deformable-attention projections
-# stay replicated: their head axis is folded with levels*points and per-query
-# gathers dominate, so TP there buys little and costs reshard traffic.
-RTDETR_TP_RULES: Rules = (
+# The shared transformer-block rule set: every family's attention q/k/v/out
+# and MLP fc1/fc2 come from models/layers.py (MultiHeadAttention, QuantDense
+# named fc1/fc2), so one regex family covers the encoder/decoder stacks of
+# RT-DETR and the CLIP towers of OWL-ViT alike. Deformable-attention
+# projections (sampling_offsets / attention_weights / value_proj /
+# output_proj) stay replicated by omission: their head axis is folded with
+# levels*points and per-query gathers dominate, so TP there buys little and
+# costs reshard traffic.
+TRANSFORMER_TP_RULES: Rules = (
     (r".*/(fc1|q_proj|k_proj|v_proj)/kernel$", P(None, "tp")),
     (r".*/(fc1|q_proj|k_proj|v_proj)/bias$", P("tp")),
     (r".*/(fc2|out_proj)/kernel$", P("tp", None)),
 )
+
+# RT-DETR family (models/rtdetr.py param tree): the shared transformer rules
+# are the whole story — backbone convs and the deformable projections stay
+# replicated (see note above).
+RTDETR_TP_RULES: Rules = TRANSFORMER_TP_RULES
+
+# OWL-ViT / OWLv2 (models/owlvit.py): the vision tower (the ViT-L-class HBM
+# half at owlv2 resolution) and the text tower are both stacks of
+# layers.MultiHeadAttention + fc1/fc2 blocks, so the transformer rules split
+# every attention/MLP weight in BOTH towers. Heads (class/box/objectness)
+# and embedding tables stay replicated: they are small and their outputs
+# feed host postprocess directly.
+OWLVIT_TP_RULES: Rules = TRANSFORMER_TP_RULES
+
+# YOLOS / DETR-lineage families share the same layer vocabulary.
+VIT_TP_RULES: Rules = TRANSFORMER_TP_RULES
 
 
 def spec_for_path(path: str, rules: Rules) -> P:
@@ -37,13 +68,60 @@ def spec_for_path(path: str, rules: Rules) -> P:
     return P()
 
 
+def _leaf_path(key_path) -> str:
+    return "/".join(
+        getattr(k, "key", getattr(k, "idx", str(k))).__str__() for k in key_path
+    )
+
+
+def match_partition_rules(rules: Rules, params):
+    """Pytree of PartitionSpec for `params` per `rules` (SNIPPETS [3] shape).
+
+    First matching rule wins; scalar leaves and unmatched paths replicate.
+    Pure spec resolution — no mesh, no divisibility fallback (that belongs
+    to `param_shardings`, which knows the mesh extents).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for key_path, leaf in flat:
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            specs.append(P())  # never partition scalars
+            continue
+        specs.append(spec_for_path(_leaf_path(key_path), rules))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def unmatched_rules(params, rules: Rules) -> list[str]:
+    """Rule patterns that matched NO param path — dead rules (empty = all
+    rules earn their keep). A dead rule usually means a layer was renamed
+    and its weights silently serve fully replicated."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    paths = [_leaf_path(kp) for kp, _ in flat]
+    dead = []
+    for pattern, _ in rules:
+        if not any(re.match(pattern, p) for p in paths):
+            dead.append(pattern)
+    return dead
+
+
+def check_rules_cover(params, rules: Rules, family: str = "") -> None:
+    """Fail loud on rules that match nothing in this param tree."""
+    dead = unmatched_rules(params, rules)
+    if dead:
+        raise ValueError(
+            f"TP rule(s) for {family or 'this model'} matched no parameter: "
+            f"{dead} — the param tree has drifted from the rule set "
+            f"(ModelFamily.tp_rules); a dead rule means those weights would "
+            f"silently serve fully replicated"
+        )
+
+
 def _tree_paths_and_specs(params, rules: Rules, mesh: Mesh):
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     specs = []
     for key_path, leaf in flat:
-        path = "/".join(
-            getattr(k, "key", getattr(k, "idx", str(k))).__str__() for k in key_path
-        )
+        path = _leaf_path(key_path)
         spec = spec_for_path(path, rules)
         # A rule that names an axis the leaf can't be split on (ndim or
         # divisibility) would crash device_put deep inside XLA; fall back to
@@ -68,6 +146,96 @@ def param_shardings(params, mesh: Mesh, rules: Rules = ()):
 def shard_params(params, mesh: Mesh, rules: Rules = ()):
     """device_put the whole param tree onto the mesh per `rules`."""
     return jax.device_put(params, param_shardings(params, mesh, rules))
+
+
+def _leaf_nbytes(leaf) -> int:
+    shape = getattr(leaf, "shape", ())
+    dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+    return int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+
+
+def sharding_report(params, mesh: Mesh, rules: Rules = ()) -> dict:
+    """Explain what `shard_params` would do: per-param rows + totals.
+
+    Works on concrete arrays AND abstract leaves (ShapeDtypeStructs from
+    `jax.eval_shape`), so a ViT-L-class tree can be audited without paying
+    its init. Rows: {path, spec, bytes, per_device_bytes, sharded,
+    fallback}; `fallback` marks leaves a rule matched but the mesh extents
+    couldn't divide (served replicated — correct, but worth seeing).
+    Totals: replicated vs per-device bytes and their ratio (the ≤ ~60%
+    at tp=2 acceptance quantity), plus the dead-rule list.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    rows = []
+    total = per_device = 0
+    for key_path, leaf in flat:
+        path = _leaf_path(key_path)
+        matched = spec_for_path(path, rules)
+        spec = matched
+        fallback = False
+        if len(spec) > len(getattr(leaf, "shape", ())) or any(
+            axis is not None and leaf.shape[dim] % mesh.shape[axis]
+            for dim, axis in enumerate(spec)
+        ):
+            spec = P()
+            fallback = matched != P()
+        nbytes = _leaf_nbytes(leaf)
+        factor = 1
+        for axis in spec:
+            if axis is not None:
+                factor *= int(mesh.shape[axis])
+        shard_bytes = nbytes // factor
+        total += nbytes
+        per_device += shard_bytes
+        rows.append({
+            "path": path,
+            "spec": str(spec),
+            "bytes": nbytes,
+            "per_device_bytes": shard_bytes,
+            "sharded": factor > 1,
+            "fallback": fallback,
+        })
+    return {
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "rows": rows,
+        "replicated_bytes": total,
+        "per_device_bytes": per_device,
+        "per_device_ratio": (per_device / total) if total else 1.0,
+        "sharded_params": sum(1 for r in rows if r["sharded"]),
+        "fallback_params": sum(1 for r in rows if r["fallback"]),
+        "unmatched_rules": unmatched_rules(params, rules),
+    }
+
+
+def format_sharding_report(report: dict, max_rows: Optional[int] = None) -> str:
+    """Human view of `sharding_report` (the --explain-sharding dump)."""
+    mesh = report["mesh"]
+    lines = [
+        f"mesh: {' x '.join(f'{k}={v}' for k, v in mesh.items())}",
+        f"{'param path':<64} {'spec':<18} {'bytes':>12} {'per-device':>12}",
+    ]
+    rows = report["rows"]
+    shown = rows if max_rows is None else rows[:max_rows]
+    for r in shown:
+        marker = " (fallback: replicated)" if r["fallback"] else ""
+        lines.append(
+            f"{r['path']:<64} {r['spec']:<18} {r['bytes']:>12} "
+            f"{r['per_device_bytes']:>12}{marker}"
+        )
+    if len(shown) < len(rows):
+        lines.append(f"... {len(rows) - len(shown)} more params")
+    lines.append(
+        f"total {report['replicated_bytes']} B replicated -> "
+        f"{report['per_device_bytes']} B/device "
+        f"({100.0 * report['per_device_ratio']:.1f}% of replicated; "
+        f"{report['sharded_params']} params sharded, "
+        f"{report['fallback_params']} fell back replicated)"
+    )
+    if report["unmatched_rules"]:
+        lines.append(
+            f"DEAD RULES (matched nothing): {report['unmatched_rules']}"
+        )
+    return "\n".join(lines)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -19,6 +19,12 @@ from pydantic import BaseModel, HttpUrl
 
 class DetectionRequest(BaseModel):
     image_urls: list[HttpUrl]
+    # Open-vocabulary extension (ISSUE 13, additive like `degraded`): free-
+    # text labels to detect INSTEAD of the deploy-time vocabulary. Only
+    # text-conditioned families (OWL-ViT/OWLv2) accept it — closed-set
+    # models answer 400; absent/None keeps the reference request shape and
+    # behavior exactly.
+    queries: list[str] | None = None
 
 
 class DetectionResult(BaseModel):

@@ -10,16 +10,22 @@ standalone aiohttp server, and tests never need Ray, matching the reference's
 own practice of testing the undecorated class: test_serve.py:32).
 """
 
+import logging
 import os
 
 from spotter_tpu.engine.batcher import MicroBatcher
 from spotter_tpu.engine.engine import InferenceEngine, default_batch_buckets
 from spotter_tpu.models import build_detector
+from spotter_tpu.models.registry import family_for
 from spotter_tpu.serving.detector import AmenitiesDetector
+
+logger = logging.getLogger(__name__)
 
 DETECTION_THRESHOLD = 0.5  # serve.py:107
 
 SERVE_DP_ENV = "SPOTTER_TPU_SERVE_DP"
+SERVE_TP_ENV = "SPOTTER_TPU_SERVE_TP"
+MESH_ENV = "SPOTTER_TPU_MESH"
 
 
 def serve_dp_from_env() -> int:
@@ -34,6 +40,18 @@ def serve_dp_from_env() -> int:
         return max(1, len(jax.local_devices()))
     if not raw.isdigit():
         raise ValueError(f"{SERVE_DP_ENV} must be a positive int or 'all', got {raw!r}")
+    return max(1, int(raw))
+
+
+def serve_tp_from_env() -> int:
+    """SPOTTER_TPU_SERVE_TP: tensor-parallel width (0/1/unset = params whole
+    on every chip). Composes with SERVE_DP into a dp×tp mesh; the bucket
+    ladder scales by dp ONLY — tp splits weights, not the batch."""
+    raw = os.environ.get(SERVE_TP_ENV, "").strip()
+    if not raw:
+        return 1
+    if not raw.isdigit():
+        raise ValueError(f"{SERVE_TP_ENV} must be a positive int, got {raw!r}")
     return max(1, int(raw))
 
 
@@ -92,6 +110,7 @@ def build_detector_app(
     from spotter_tpu.serving.lifecycle import maybe_enable_compile_cache
 
     maybe_enable_compile_cache()
+    env_buckets = False
     if batch_buckets is None:
         # Per-model ladder tuning is a deployment concern: R18's per-chip
         # peak is batch 16 (485 vs 449 img/s — BASELINE.md round-4 sweep),
@@ -99,6 +118,7 @@ def build_detector_app(
         # `is not None` (not truthiness): an explicitly-set empty value is
         # a malformed spec and must raise, not silently serve the default.
         spec = os.environ.get("SPOTTER_TPU_BATCH_BUCKETS")
+        env_buckets = spec is not None
         batch_buckets = (
             parse_batch_buckets(spec)
             if spec is not None
@@ -111,26 +131,42 @@ def build_detector_app(
     # Ray pinning each replica via TPU_VISIBLE_CHIPS).
     mesh = None
     tp_rules = ()
-    mesh_spec = mesh_spec or os.environ.get("SPOTTER_TPU_MESH")
-    # dp-sharded serving as a first-class config (ISSUE 3):
-    # SPOTTER_TPU_SERVE_DP=<n|all> shards the REAL serving path (engine +
-    # batcher + HTTP) over n local chips. Unlike the expert SPOTTER_TPU_MESH
-    # knob (which keeps the configured ladder and merely rounds it up), the
-    # bucket ladder here stays per-chip semantics and is scaled to the
-    # AGGREGATE: the batcher fills dp × per_chip_bucket before dispatch, so
-    # each chip keeps the per-chip batch the ladder was tuned for. An
-    # explicit SPOTTER_TPU_MESH wins when both are set.
-    if not mesh_spec:
-        dp = serve_dp if serve_dp is not None else serve_dp_from_env()
-        if dp > 1:
-            batch_buckets = tuple(b * dp for b in batch_buckets)
-            mesh_spec = f"dp={dp}"
+    mesh_source = None
+    mesh_spec = mesh_spec or os.environ.get(MESH_ENV)
+    # dp×tp serving as a first-class config (ISSUES 3 + 13):
+    # SPOTTER_TPU_SERVE_DP=<n|all> shards the batch over n chip GROUPS and
+    # SPOTTER_TPU_SERVE_TP=<m> splits the params m-way inside each group.
+    # Unlike the expert SPOTTER_TPU_MESH knob (which keeps the configured
+    # ladder and merely rounds it up), the bucket ladder here stays per-
+    # group semantics and is scaled by dp ONLY: the batcher fills
+    # dp × per_chip_bucket before dispatch — tp splits weights, never the
+    # batch, so each tp group keeps the batch the ladder was tuned for.
+    serve_dp_set = serve_dp is not None or bool(
+        os.environ.get(SERVE_DP_ENV, "").strip()
+    )
+    serve_tp_set = bool(os.environ.get(SERVE_TP_ENV, "").strip())
     if mesh_spec:
-        from spotter_tpu.parallel import (
-            RTDETR_TP_RULES,
-            initialize_multihost,
-            make_mesh,
-        )
+        mesh_source = MESH_ENV
+        if serve_dp_set or serve_tp_set:
+            # the knob conflict, loud instead of silent (ISSUE 13 satellite:
+            # SERVE_DP previously just lost here with no trace)
+            logger.warning(
+                "%s=%r wins over %s/%s — the SERVE_* knobs are ignored while"
+                " an explicit mesh spec is set; the resolved mesh is surfaced"
+                " in /healthz",
+                MESH_ENV, mesh_spec, SERVE_DP_ENV, SERVE_TP_ENV,
+            )
+    else:
+        dp = serve_dp if serve_dp is not None else serve_dp_from_env()
+        tp = serve_tp_from_env()
+        if dp > 1 or tp > 1:
+            batch_buckets = tuple(b * dp for b in batch_buckets)
+            mesh_spec = f"dp={dp},tp={tp}"
+            mesh_source = (
+                f"{SERVE_DP_ENV} x {SERVE_TP_ENV}" if tp > 1 else SERVE_DP_ENV
+            )
+    if mesh_spec:
+        from spotter_tpu.parallel import initialize_multihost, make_mesh
 
         # Multi-host bring-up belongs to the SPMD-mesh mode ONLY: exactly one
         # process per host may join jax.distributed, which is true when the
@@ -142,11 +178,27 @@ def build_detector_app(
         initialize_multihost()
 
         axes = parse_mesh_spec(mesh_spec)
-        mesh = make_mesh(dp=axes["dp"], tp=axes["tp"])
-        # The TP rule set names the shared transformer projections
-        # (models/layers.py: fc1/fc2, q/k/v/out_proj) used by every family;
-        # non-matching params fall back to replicated (sharding.py).
-        tp_rules = RTDETR_TP_RULES if axes["tp"] > 1 else ()
+        if env_buckets and any(b % axes["dp"] for b in batch_buckets):
+            # An OPERATOR-configured ladder that doesn't divide the dp axis
+            # is a config contradiction: reject up front with both knobs
+            # named (ISSUE 13 satellite) instead of silently rounding up.
+            # Constructor-arg ladders (library/tests) keep the engine's
+            # documented round-up semantics.
+            raise ValueError(
+                f"SPOTTER_TPU_BATCH_BUCKETS={list(batch_buckets)} not "
+                f"divisible by dp={axes['dp']} (from "
+                f"{mesh_source or MESH_ENV}): every bucket must split "
+                f"evenly across the dp axis"
+            )
+        mesh = make_mesh(
+            dp=axes["dp"], tp=axes["tp"], source=mesh_source or MESH_ENV
+        )
+        # Per-family TP rule set from the registry (ISSUE 13): tp=2 on an
+        # OWL-ViT deployment shards the CLIP towers, RT-DETR its
+        # encoder/decoder stacks; non-matching params fall back to
+        # replicated, and a rule matching NOTHING fails loud in the engine
+        # (sharding.check_rules_cover).
+        tp_rules = family_for(model_name).tp_rules if axes["tp"] > 1 else ()
 
     built = build_detector(model_name)
     engine = InferenceEngine(
@@ -156,6 +208,8 @@ def build_detector_app(
         mesh=mesh,
         tp_rules=tp_rules,
     )
+    # /healthz surfaces which knob produced the serving mesh (satellite 2)
+    engine.mesh_source = mesh_source
     if warmup:
         engine.warmup()
     # Resilience knobs (ISSUE 1) ride the environment into the batcher:
@@ -177,6 +231,44 @@ def build_detector_app(
 
     cache = ResultCache.from_env(metrics=engine.metrics, max_mb=cache_mb)
     return AmenitiesDetector(engine, batcher, cache=cache)
+
+
+def explain_sharding(
+    model_name: str | None = None, mesh_spec: str | None = None
+) -> str:
+    """The `--explain-sharding` dump (ISSUE 13): build the model + the
+    resolved serving mesh and report param path -> PartitionSpec ->
+    per-device bytes, plus the dead-rule list. Read-only: no engine, no
+    warmup, no compile — just the param tree and the rule set.
+    """
+    from spotter_tpu.parallel import make_mesh
+    from spotter_tpu.parallel.sharding import (
+        format_sharding_report,
+        sharding_report,
+    )
+
+    model_name = model_name or os.environ.get("MODEL_NAME")
+    if not model_name:
+        raise ValueError("MODEL_NAME environment variable not set.")
+    mesh_spec = mesh_spec or os.environ.get(MESH_ENV)
+    if mesh_spec:
+        axes = parse_mesh_spec(mesh_spec)
+        source = MESH_ENV
+    else:
+        dp = serve_dp_from_env()
+        tp = serve_tp_from_env()
+        axes = {"dp": dp, "tp": tp}
+        source = f"{SERVE_DP_ENV} x {SERVE_TP_ENV}"
+    mesh = make_mesh(dp=axes["dp"], tp=axes["tp"], source=source)
+    family = family_for(model_name)
+    rules = family.tp_rules if axes["tp"] > 1 else ()
+    built = build_detector(model_name)
+    report = sharding_report(built.params, mesh, rules)
+    header = (
+        f"model {model_name} (family {family.name}), "
+        f"{len(rules)} TP rule(s) active"
+    )
+    return header + "\n" + format_sharding_report(report)
 
 
 def ray_deployment():

@@ -74,6 +74,7 @@ from spotter_tpu import obs
 from spotter_tpu.caching.keys import content_key, url_key
 from spotter_tpu.caching.result_cache import ResultCache
 from spotter_tpu.caching.singleflight import SingleFlight
+from spotter_tpu.caching.text_cache import TextQueryResolver
 from spotter_tpu.engine.batcher import MicroBatcher
 from spotter_tpu.engine.errors import PoisonImageError
 from spotter_tpu.engine.engine import InferenceEngine
@@ -117,6 +118,13 @@ DEFAULT_FETCH_MAX_BYTES = 32 * 1024 * 1024
 # 4xx statuses that ARE worth retrying (timeout, rate limit); every other
 # 4xx is deterministic and fails fast
 RETRYABLE_4XX = (408, 429)
+
+
+class QueriesUnsupportedError(ValueError):
+    """A /detect carried free-text `queries` but the served model family is
+    closed-set (no text encoder). The HTTP layer answers 400 — the request
+    can never succeed on this deployment, so retrying or 500ing would both
+    mislead the client."""
 
 
 class FetchError(RuntimeError):
@@ -201,6 +209,17 @@ class AmenitiesDetector:
         built = getattr(engine, "built", None)
         self._cache_model = getattr(built, "model_name", None) or type(engine).__name__
         self._cache_threshold = float(getattr(engine, "threshold", 0.5))
+        # Open vocabulary (ISSUE 13): text-conditioned families get a
+        # memoized query-set resolver (the text-embedding cache); closed-set
+        # families keep None and /detect `queries` answer 400.
+        text_encoder = getattr(built, "text_encoder", None)
+        self._text_resolver = (
+            TextQueryResolver(
+                self._cache_model, text_encoder, metrics=engine.metrics
+            )
+            if text_encoder is not None
+            else None
+        )
 
     def _check_fetch_size(self, url: str, nbytes: int) -> None:
         if self.fetch_max_bytes > 0 and nbytes > self.fetch_max_bytes:
@@ -356,6 +375,7 @@ class AmenitiesDetector:
         cls: str | None = None,
         degraded: set[str] | None = None,
         info: dict | None = None,
+        qset=None,
     ) -> ImageResult:
         # the ambient request trace (ISSUE 7): span capture below is a
         # monotonic read + list append per stage; None (recorder off, or a
@@ -379,6 +399,11 @@ class AmenitiesDetector:
                     cache_key = content_key(
                         self._cache_model, image_bytes, self._cache_threshold
                     )
+                    if qset is not None:
+                        # the detections depend on the vocabulary too: a
+                        # closed-set hit must never answer a queried request
+                        # (or two different vocabularies each other)
+                        cache_key = f"{cache_key}|q{qset.digest}"
                     # repeat poison: re-raise the cached verdict instead of
                     # letting the same bytes re-poison a batch through the
                     # bisect machinery
@@ -446,7 +471,8 @@ class AmenitiesDetector:
                         else "miss",
                     )
                 raw_detections = await self.batcher.submit(
-                    image, deadline=deadline, key=cache_key, cls=cls
+                    image, deadline=deadline, key=cache_key, cls=cls,
+                    qset=qset,
                 )
 
             # brownout threshold rung (ISSUE 8): raise the effective
@@ -464,7 +490,13 @@ class AmenitiesDetector:
                 draw = ImageDraw.Draw(image)
                 image_detections: list[DetectionResult] = []
                 for det in raw_detections:
-                    amenity = AMENITIES_MAPPING.get(det["label"])
+                    # open-vocab (ISSUE 13): the client's own queries ARE the
+                    # label set — the amenity taxonomy filter only applies to
+                    # the closed-set deployment vocabulary
+                    amenity = (
+                        det["label"] if qset is not None
+                        else AMENITIES_MAPPING.get(det["label"])
+                    )
                     if amenity is None:
                         continue
                     box = det["box"]
@@ -572,11 +604,26 @@ class AmenitiesDetector:
         request = DetectionRequest.model_validate(payload)
         if deadline is None:
             deadline = Deadline.from_env()
+        # Open vocabulary (ISSUE 13): resolve the request's query set ONCE
+        # through the text-embedding cache (a repeated vocabulary costs a
+        # dict lookup, a novel one pays the text-tower encode off the event
+        # loop) — every image in the request shares the resolved set, which
+        # is also its batch-compatibility group downstream.
+        qset = None
+        if request.queries:
+            if self._text_resolver is None:
+                raise QueriesUnsupportedError(
+                    f"model '{self._cache_model}' is closed-set: free-text "
+                    f"`queries` need a text-conditioned family (OWL-ViT/OWLv2)"
+                )
+            qset = await asyncio.get_running_loop().run_in_executor(
+                None, self._text_resolver.resolve, list(request.queries)
+            )
         urls = [str(u) for u in request.image_urls]
         degraded: set[str] = set()
         tasks = [
             self._process_single_image(
-                u, deadline, cls=cls, degraded=degraded, info=info
+                u, deadline, cls=cls, degraded=degraded, info=info, qset=qset
             )
             for u in urls
         ]
@@ -685,6 +732,28 @@ class AmenitiesDetector:
             # replica runs — dp width and whether preprocess is on-device —
             # so a fleet rollout of the new pipeline is auditable per pod
             "dp": dp,
+            # tensor-parallel topology (ISSUE 13): the RESOLVED mesh this
+            # replica actually serves on (tp=1 single-chip included) plus
+            # which knob produced it — the MESH-vs-SERVE_DP/TP precedence
+            # is auditable here instead of silently losing (satellite 2)
+            "tp": getattr(self.engine, "tp", 1),
+            "mesh": (
+                {
+                    "dp": dp,
+                    "tp": getattr(self.engine, "tp", 1),
+                    "source": getattr(self.engine, "mesh_source", None),
+                }
+                if getattr(self.engine, "mesh", None) is not None
+                else None
+            ),
+            # open-vocabulary capability (ISSUE 13): whether this replica
+            # accepts free-text `queries`, with the text-embedding cache's
+            # size state when it does
+            "open_vocab": (
+                self._text_resolver.stats()
+                if self._text_resolver is not None
+                else {"enabled": False}
+            ),
             "device_preprocess": getattr(self.engine, "device_preprocess", False),
             # ragged scheduling (ISSUE 9): which dispatch policy this
             # replica runs (FIFO unless SPOTTER_TPU_RAGGED=1), auditable

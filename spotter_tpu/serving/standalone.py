@@ -55,6 +55,7 @@ from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.ops import preprocess
 from spotter_tpu.serving import lifecycle, wire
+from spotter_tpu.serving.detector import QueriesUnsupportedError
 from spotter_tpu.serving.fleet import classify_request
 from spotter_tpu.serving.resilience import AdmissionError
 from spotter_tpu.testing import faults, stub_engine
@@ -261,6 +262,11 @@ def make_app(
             response = await det.detect(payload, cls=cls, info=info)
         except pydantic.ValidationError as exc:
             return done(web.Response(status=400, text=f"Invalid request: {exc}"))
+        except QueriesUnsupportedError as exc:
+            # open-vocab queries on a closed-set model (ISSUE 13): the
+            # request can never succeed on this deployment — a client
+            # error, not a server one
+            return done(web.Response(status=400, text=str(exc)))
         except AdmissionError as exc:  # every image shed -> 429/503
             return done(_shed_response(exc))
         except Exception:
@@ -439,6 +445,23 @@ def main() -> None:
         "'all' = every local chip)",
     )
     parser.add_argument(
+        "--serve-tp",
+        default=None,
+        help="tensor-parallel width: split the model's attention/MLP "
+        "weights over this many chips per dp group "
+        "(SPOTTER_TPU_SERVE_TP; composes with --serve-dp into a dp×tp "
+        "mesh — the bucket ladder scales by dp only). Use when one chip's "
+        "HBM can't hold (or serve fast enough) the model, e.g. "
+        "OWLv2/ViT-L at tp=2/4",
+    )
+    parser.add_argument(
+        "--explain-sharding",
+        action="store_true",
+        help="print the per-param sharding report for the resolved mesh "
+        "(param path -> PartitionSpec -> per-device bytes, dead TP rules "
+        "flagged) and exit without serving",
+    )
+    parser.add_argument(
         "--device-preprocess",
         action="store_true",
         help="uint8 ingest + on-device rescale/normalize "
@@ -484,6 +507,13 @@ def main() -> None:
     # respawn of it) reads them there, so flag and env behave identically
     if args.serve_dp is not None:
         os.environ["SPOTTER_TPU_SERVE_DP"] = str(args.serve_dp)
+    if args.serve_tp is not None:
+        os.environ["SPOTTER_TPU_SERVE_TP"] = str(args.serve_tp)
+    if args.explain_sharding:
+        from spotter_tpu.serving.app import explain_sharding
+
+        print(explain_sharding(args.model))
+        return
     if args.device_preprocess:
         os.environ["SPOTTER_TPU_DEVICE_PREPROCESS"] = "1"
     if args.ragged:

@@ -1,0 +1,304 @@
+"""Open-vocabulary workload suite (ISSUE 13): runtime `queries` through the
+text-embedding cache, query-group batch isolation, the /detect wire contract
+(tiny OWL-ViT on the virtual CPU mesh), and the closed-set 400."""
+
+import asyncio
+import os
+from io import BytesIO
+from unittest.mock import AsyncMock
+
+import httpx
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from PIL import Image
+
+os.environ["SPOTTER_TPU_TINY"] = "1"
+
+from spotter_tpu.caching.keys import queries_key
+from spotter_tpu.caching.text_cache import QuerySet, TextQueryResolver
+from spotter_tpu.engine.batcher import MicroBatcher
+from spotter_tpu.engine.engine import InferenceEngine
+from spotter_tpu.engine.metrics import Metrics
+from spotter_tpu.engine.scheduler import QueueItem, Scheduler
+from spotter_tpu.models import build_detector
+from spotter_tpu.serving.detector import AmenitiesDetector, QueriesUnsupportedError
+from spotter_tpu.serving.standalone import make_app
+
+
+@pytest.fixture(scope="module")
+def owl():
+    built = build_detector("google/owlvit-base-patch32")
+    engine = InferenceEngine(built, threshold=0.0, batch_buckets=(1, 2, 4))
+    return built, engine
+
+
+def _images(n, seed=5):
+    rng = np.random.default_rng(seed)
+    return [
+        Image.fromarray(rng.integers(0, 255, (36, 36, 3), np.uint8))
+        for _ in range(n)
+    ]
+
+
+def _stub_http_client():
+    img = Image.fromarray(np.full((32, 32, 3), 96, np.uint8))
+    buf = BytesIO()
+    img.save(buf, format="JPEG")
+    resp = AsyncMock()
+    resp.content = buf.getvalue()
+    resp.raise_for_status = lambda: None
+    client = AsyncMock(spec=httpx.AsyncClient)
+    client.get.return_value = resp
+    return client
+
+
+# ---------------------------------------------------------------------------
+# text-embedding cache
+# ---------------------------------------------------------------------------
+
+
+def test_queries_key_is_order_insensitive_and_model_scoped():
+    assert queries_key("m", ["dog", "couch"]) == queries_key("m", ["couch", "dog"])
+    assert queries_key("m", ["dog"]) != queries_key("m2", ["dog"])
+    assert queries_key("m", ["dog"]) != queries_key("m", ["cat"])
+
+
+def test_resolver_caches_and_pads(owl):
+    built, engine = owl
+    metrics = Metrics()
+    res = TextQueryResolver(built.model_name, built.text_encoder,
+                            metrics=metrics, pad=8)
+    qs = res.resolve(["couch", "dog", "palm tree"])
+    assert qs.labels == ("couch", "dog", "palm tree")  # canonical sorted
+    assert qs.embeds.shape[0] == 8 and qs.mask.tolist() == [1, 1, 1, 0, 0, 0, 0, 0]
+    # repeated vocabulary (any order) is a hit on the SAME entry
+    assert res.resolve(["dog", "palm tree", "couch"]) is qs
+    snap = metrics.snapshot()
+    assert snap["text_cache_hits_total"] == 1
+    assert snap["text_cache_misses_total"] == 1
+    assert snap["text_cache_miss_ms_p50"] > snap["text_cache_hit_ms_p50"]
+
+
+def test_resolver_rejects_empty_and_bounds_entries(owl):
+    built, _ = owl
+    res = TextQueryResolver(built.model_name, built.text_encoder, max_entries=2)
+    with pytest.raises(ValueError):
+        res.resolve(["", "  "])
+    res.resolve(["a"]); res.resolve(["b"]); res.resolve(["c"])
+    assert res.stats()["entries"] == 2  # LRU-bounded
+
+
+def test_text_encoder_is_deterministic(owl):
+    built, _ = owl
+    a = built.text_encoder(["couch", "dog"])
+    b = built.text_encoder(["couch", "dog"])
+    np.testing.assert_array_equal(a, b)
+    norms = np.linalg.norm(a, axis=-1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# engine + scheduler + batcher
+# ---------------------------------------------------------------------------
+
+
+def test_engine_detect_with_qset_labels_from_queries(owl):
+    built, engine = owl
+    res = TextQueryResolver(built.model_name, built.text_encoder)
+    qs = res.resolve(["couch", "dog"])
+    dets = engine.detect(_images(3), qset=qs)
+    assert len(dets) == 3
+    labels = {d["label"] for ds in dets for d in ds}
+    assert labels and labels <= {"couch", "dog"}
+    # deterministic across calls (same program, same constants)
+    assert dets == engine.detect(_images(3), qset=qs)
+
+
+def test_engine_qset_padding_is_invisible(owl):
+    """The padded query slots (mask 0) can never produce a detection: the
+    same vocabulary padded to different widths detects identically."""
+    built, engine = owl
+    res8 = TextQueryResolver(built.model_name, built.text_encoder, pad=8)
+    res4 = TextQueryResolver(built.model_name, built.text_encoder, pad=4)
+    imgs = _images(2, seed=9)
+    a = engine.detect(imgs, qset=res8.resolve(["couch", "dog", "tv"]))
+    b = engine.detect(imgs, qset=res4.resolve(["couch", "dog", "tv"]))
+    for da, db in zip(a, b):
+        assert [d["label"] for d in da] == [d["label"] for d in db]
+        np.testing.assert_allclose(
+            np.asarray([d["box"] for d in da], np.float32),
+            np.asarray([d["box"] for d in db], np.float32),
+            atol=1e-4,
+        )
+
+
+def test_closed_set_engine_rejects_qset():
+    built = build_detector("PekingU/rtdetr_v2_r18vd")
+    engine = InferenceEngine(built, threshold=0.0, batch_buckets=(1,))
+    qs = QuerySet(
+        key="k", digest="d", labels=("x",),
+        embeds=np.zeros((8, 4), np.float32), mask=np.zeros((8,), np.int32),
+    )
+    with pytest.raises(ValueError, match="closed-set"):
+        engine.detect(_images(1), qset=qs)
+
+
+def test_scheduler_never_mixes_query_groups():
+    def item(group):
+        qs = None
+        if group is not None:
+            qs = QuerySet(
+                key=group, digest=group, labels=("x",),
+                embeds=np.zeros((1, 2), np.float32),
+                mask=np.ones((1,), np.int32),
+            )
+        fut = type("F", (), {"done": staticmethod(lambda: False)})()
+        img = Image.new("RGB", (16, 16))
+        return QueueItem(image=img, fut=fut, qset=qs, t_submit=0.0)
+
+    sched = Scheduler(spec=None, ragged=False)
+    pending = [item("a"), item("a"), item("b"), item(None), item("a")]
+    plan = sched.plan(pending, target=8)
+    assert [it.group for it in plan.items] == ["a", "a", "a"]
+    # the other groups stay pending, in order, for the next plans
+    assert [it.group for it in pending] == ["b", None]
+    plan2 = sched.plan(pending, target=8)
+    assert [it.group for it in plan2.items] == ["b"]
+    plan3 = sched.plan(pending, target=8)
+    assert [it.group for it in plan3.items] == [None]
+    assert pending == []
+
+
+def test_batcher_dispatches_each_query_group_separately(owl):
+    built, engine = owl
+    res = TextQueryResolver(built.model_name, built.text_encoder)
+    qs_a = res.resolve(["couch"])
+    qs_b = res.resolve(["dog", "tv"])
+    batcher = MicroBatcher(engine, max_delay_ms=30.0)
+    imgs = _images(4, seed=13)
+
+    async def drive():
+        tasks = [
+            batcher.submit(imgs[0], qset=qs_a),
+            batcher.submit(imgs[1], qset=qs_a),
+            batcher.submit(imgs[2], qset=qs_b),
+            batcher.submit(imgs[3], qset=qs_b),
+        ]
+        results = await asyncio.gather(*tasks)
+        await batcher.stop()
+        return results
+
+    results = asyncio.run(drive())
+    for r in results[:2]:
+        assert {d["label"] for d in r} <= {"couch"}
+    for r in results[2:]:
+        assert {d["label"] for d in r} <= {"dog", "tv"}
+    # group isolation: 4 submits over 2 vocabularies can never be 1 batch
+    assert engine.metrics.snapshot()["batches_total"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# /detect wire contract
+# ---------------------------------------------------------------------------
+
+
+def test_detect_endpoint_open_vocab_round_trip(owl):
+    built, engine = owl
+    detector = AmenitiesDetector(
+        engine, MicroBatcher(engine, max_delay_ms=1.0), _stub_http_client()
+    )
+
+    async def run():
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post("/detect", json={
+                "image_urls": ["http://example.com/room.jpg"],
+                "queries": ["couch", "potted plant"],
+            })
+            assert resp.status == 200
+            body = await resp.json()
+            (img,) = body["images"]
+            labels = {d["label"] for d in img["detections"]}
+            assert labels and labels <= {"couch", "potted plant"}
+            # the description is built from the request's own vocabulary
+            assert any(q in body["amenities_description"]
+                       for q in ("couch", "potted plant"))
+            assert img["labeled_image_base64"]
+
+            # /healthz advertises the open-vocab capability + resolved mesh
+            health = await (await client.get("/healthz")).json()
+            assert health["open_vocab"]["enabled"] is True
+            assert health["tp"] == 1 and health["mesh"] is None
+
+            # repeated vocabulary hits the text cache
+            await client.post("/detect", json={
+                "image_urls": ["http://example.com/room.jpg"],
+                "queries": ["potted plant", "couch"],
+            })
+            snap = await (await client.get("/metrics")).json()
+            assert snap["text_cache_hits_total"] >= 1
+            assert snap["text_cache_misses_total"] >= 1
+
+            # absent queries keeps the exact closed-set reference contract
+            resp = await client.post("/detect", json={
+                "image_urls": ["http://example.com/room.jpg"],
+            })
+            assert resp.status == 200
+            body = await resp.json()
+            assert set(body.keys()) == {"amenities_description", "images"}
+
+    asyncio.run(run())
+
+
+def test_detect_endpoint_queries_on_closed_set_model_400():
+    built = build_detector("PekingU/rtdetr_v2_r18vd")
+    engine = InferenceEngine(built, threshold=0.0, batch_buckets=(1,))
+    detector = AmenitiesDetector(
+        engine, MicroBatcher(engine, max_delay_ms=1.0), _stub_http_client()
+    )
+
+    async def run():
+        with pytest.raises(QueriesUnsupportedError):
+            await detector.detect({
+                "image_urls": ["http://example.com/a.jpg"],
+                "queries": ["couch"],
+            })
+        app = make_app(detector=detector)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post("/detect", json={
+                "image_urls": ["http://example.com/a.jpg"],
+                "queries": ["couch"],
+            })
+            assert resp.status == 400
+            assert "closed-set" in await resp.text()
+        health = detector.health()
+        assert health["open_vocab"] == {"enabled": False}
+
+    asyncio.run(run())
+
+
+def test_result_cache_key_separates_vocabularies(owl):
+    """Cache armed: the same image bytes under two vocabularies (or under
+    the closed set) never share a result-cache entry."""
+    from spotter_tpu.caching.result_cache import ResultCache
+
+    built, engine = owl
+    cache = ResultCache(max_bytes=1 << 20, metrics=engine.metrics)
+    detector = AmenitiesDetector(
+        engine, MicroBatcher(engine, max_delay_ms=1.0), _stub_http_client(),
+        cache=cache,
+    )
+
+    async def run():
+        p = {"image_urls": ["http://example.com/a.jpg"]}
+        await detector.detect({**p, "queries": ["couch"]})
+        await detector.detect({**p, "queries": ["dog"]})
+        await detector.detect(dict(p))
+        assert cache.stats()["entries"] == 3  # three distinct key spaces
+        hits_before = engine.metrics.snapshot()["cache_hits_total"]
+        await detector.detect({**p, "queries": ["couch"]})
+        assert engine.metrics.snapshot()["cache_hits_total"] == hits_before + 1
+        await detector.aclose()
+
+    asyncio.run(run())
