@@ -1604,6 +1604,375 @@ def fleet_obs_bench(args) -> int:
     return 0 if delta_pct < 1.0 else 1
 
 
+def gray_storm_bench(args) -> int:
+    """Gray-failure immunity, measured (ISSUE 14 acceptance): model-free
+    stub replicas behind the REAL router + ReplicaPool with adaptive
+    hedging, outlier scoring, and frame checksums armed. Three phases:
+
+    1. **Gray storm**: closed-loop load over N replicas; mid-load one is
+       turned --gray-factor x slower while still answering /healthz 200
+       (the gray-failure signature hard ejection can't see). Gates: fleet
+       p99 recovers to <= 1.5x the pre-storm baseline within 10 s, the
+       gray replica's steady-state traffic share drops under 5%, and
+       client failures = 0.
+    2. **Corrupt frames**: corrupt_frame=K armed while clients negotiate
+       the binary frame. Gates: every corruption caught by the edge CRC
+       validator and replayed (pool invalid_responses == K) with 0
+       client-visible errors.
+    3. **Unloaded overhead**: the whole immune plane (adaptive hedge +
+       outlier scoring + CRC encode/verify) ON vs OFF, interleaved paired
+       rounds over one shared replica set (the --fleet-obs protocol).
+       Gate: median paired p50 delta < 1%.
+
+    Prints ONE JSON line accepted by tools/bench_compare.py; exits
+    non-zero when any gate fails.
+    """
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving import wire
+    from spotter_tpu.serving.detector import AmenitiesDetector
+    from spotter_tpu.serving.replica_pool import ReplicaPool
+    from spotter_tpu.serving.router import make_router_app
+    from spotter_tpu.serving.standalone import make_app
+    from spotter_tpu.testing import faults
+    from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+    n_replicas = args.gray_replicas
+    service_ms = args.gray_service_ms
+    concurrency = args.gray_concurrency
+    factor = args.gray_factor
+    baseline_s = args.gray_baseline_s
+    storm_s = args.gray_storm_s
+    recovery_gate_s = 10.0
+    p99_gate_ratio = 1.5
+    share_gate = 0.05
+    overhead_gate_pct = 1.0
+    urls_cycle = [f"http://gray.example.com/img-{i}.jpg" for i in range(32)]
+
+    async def build_fleet(count: int, replica_prefix: str):
+        engines, dets, servers, urls = [], [], [], []
+        for i in range(count):
+            engine = StubEngine(service_ms=service_ms)
+            engine.metrics.set_identity(replica_id=f"{replica_prefix}{i}")
+            det = AmenitiesDetector(
+                engine,
+                MicroBatcher(engine, max_delay_ms=1.0),
+                StubHttpClient(),
+            )
+            server = TestServer(make_app(detector=det))
+            await server.start_server()
+            engines.append(engine)
+            dets.append(det)
+            servers.append(server)
+            urls.append(f"http://{server.host}:{server.port}")
+        return engines, dets, servers, urls
+
+    async def teardown(dets, servers):
+        for server in servers:
+            await server.close()
+        for det in dets:
+            await det.aclose()
+
+    async def storm_and_corrupt() -> dict:
+        engines, dets, servers, urls = await build_fleet(n_replicas, "gray-r")
+        pool = ReplicaPool(
+            urls,
+            health_interval_s=0.1,
+            adaptive_hedge=True,
+            outlier_min_samples=6,
+            outlier_min_ms=5.0,
+        )
+        agg = FleetAggregator(lambda: [], interval_s=0.0)
+        app = make_router_app(pool, aggregator=agg)
+        events: list[tuple[float, float, bool]] = []  # (t_done, ms, ok)
+        samples: list[tuple[float, list[int]]] = []  # (t, per-replica reqs)
+        stop = {"flag": False}
+        async with TestClient(TestServer(app)) as client:
+            counter = {"i": 0}
+
+            async def worker() -> None:
+                while not stop["flag"]:
+                    i = counter["i"]
+                    counter["i"] += 1
+                    t0 = time.perf_counter()
+                    resp = await client.post(
+                        "/detect",
+                        json={
+                            "image_urls": [urls_cycle[i % len(urls_cycle)]]
+                        },
+                    )
+                    await resp.read()
+                    events.append(
+                        (
+                            time.perf_counter(),
+                            (time.perf_counter() - t0) * 1e3,
+                            resp.status == 200,
+                        )
+                    )
+
+            async def sampler() -> None:
+                while not stop["flag"]:
+                    samples.append(
+                        (
+                            time.perf_counter(),
+                            [r.requests for r in pool.replicas],
+                        )
+                    )
+                    await asyncio.sleep(0.25)
+
+            workers = [
+                asyncio.create_task(worker()) for _ in range(concurrency)
+            ]
+            sampler_task = asyncio.create_task(sampler())
+            await asyncio.sleep(1.0)  # warm (connections, hedge window)
+            t_base = time.perf_counter()
+            await asyncio.sleep(baseline_s)
+            t_gray = time.perf_counter()
+            engines[0].service_s *= factor  # the gray failure: slow, alive
+            await asyncio.sleep(storm_s)
+            stop["flag"] = True
+            await asyncio.gather(*workers, sampler_task)
+
+            base_lats = [
+                ms for t, ms, ok in events if t_base <= t < t_gray and ok
+            ]
+            baseline_p99 = float(np.percentile(base_lats, 99))
+            p99_gate_ms = p99_gate_ratio * baseline_p99
+            # windowed p99 after the injection: recovery = end of the
+            # first of two consecutive half-second windows under the gate
+            win_s = 0.5
+            windows = []
+            t_end = events[-1][0]
+            w = t_gray
+            while w + win_s <= t_end:
+                lats = [
+                    ms for t, ms, ok in events if w <= t < w + win_s and ok
+                ]
+                windows.append(
+                    (w + win_s - t_gray,
+                     float(np.percentile(lats, 99)) if lats else 0.0)
+                )
+                w += win_s
+            recovery_s = None
+            for j in range(len(windows) - 1):
+                if (
+                    windows[j][1] <= p99_gate_ms
+                    and windows[j + 1][1] <= p99_gate_ms
+                ):
+                    recovery_s = windows[j][0]
+                    break
+            # steady-state share over the last --gray-share-window-s
+            share_from = t_end - args.gray_share_window_s
+            before = next(
+                (c for t, c in samples if t >= share_from), samples[-1][1]
+            )
+            after = [r.requests for r in pool.replicas]
+            deltas = [a - b for a, b in zip(after, before)]
+            share = deltas[0] / max(sum(deltas), 1)
+            failures = sum(1 for _, _, ok in events if not ok)
+            storm_snap = pool.snapshot()
+
+            # ---- phase 2: corrupt frames over the same topology ----
+            engines[0].service_s /= factor  # storm over
+            invalid_before = pool.invalid_responses_total
+            corrupt_k = args.gray_corrupt_frames
+            corrupt_errors = 0
+            with faults.inject(corrupt_frame=corrupt_k):
+                for i in range(args.gray_corrupt_requests):
+                    resp = await client.post(
+                        "/detect",
+                        json={
+                            "image_urls": [urls_cycle[i % len(urls_cycle)]]
+                        },
+                        headers={"Accept": wire.FRAME_CONTENT_TYPE},
+                    )
+                    body = await resp.read()
+                    if resp.status != 200:
+                        corrupt_errors += 1
+                    else:
+                        wire.decode_frame(body)  # client-side sanity
+            corrupt_replayed = pool.invalid_responses_total - invalid_before
+        await pool.stop()
+        await teardown(dets, servers)
+        return {
+            "baseline_p99_ms": baseline_p99,
+            "p99_gate_ms": p99_gate_ms,
+            "windows": windows,
+            "recovery_s": recovery_s,
+            "gray_share": share,
+            "client_failures": failures,
+            "requests": len(events),
+            "hedges": storm_snap["pool_hedges_total"],
+            "hedge_wins": storm_snap["pool_hedge_wins_total"],
+            "soft_ejections": storm_snap["pool_soft_ejections_total"],
+            "gray_state": storm_snap["replicas"][0]["outlier_state"],
+            "corrupt_injected": corrupt_k,
+            "corrupt_replayed": corrupt_replayed,
+            "corrupt_client_errors": corrupt_errors,
+        }
+
+    async def overhead() -> dict:
+        """Immune plane ON vs OFF, paired rounds, ONE shared replica set
+        (the --fleet-obs protocol: the pair shares its drift, the pair
+        difference isolates the plane)."""
+        import os as _os
+
+        engines, dets, servers, urls = await build_fleet(n_replicas, "ovh-r")
+        _os.environ[wire.WIRE_CRC_ENV] = "0"
+        pool_off = ReplicaPool(
+            urls, health_interval_s=0.25, outlier_ratio=0.0
+        )
+        app_off = make_router_app(
+            pool_off, aggregator=FleetAggregator(lambda: [], interval_s=0.0)
+        )
+        _os.environ[wire.WIRE_CRC_ENV] = "1"
+        pool_on = ReplicaPool(
+            urls, health_interval_s=0.25, adaptive_hedge=True
+        )
+        app_on = make_router_app(
+            pool_on, aggregator=FleetAggregator(lambda: [], interval_s=0.0)
+        )
+        off: list[float] = []
+        on: list[float] = []
+        paired: list[float] = []
+        try:
+            async with TestClient(TestServer(app_off)) as c_off, TestClient(
+                TestServer(app_on)
+            ) as c_on:
+
+                async def slice_requests(client, lats: list[float]) -> None:
+                    for i in range(args.gray_overhead_requests):
+                        t0 = time.perf_counter()
+                        resp = await client.post(
+                            "/detect",
+                            json={
+                                "image_urls": [
+                                    urls_cycle[i % len(urls_cycle)]
+                                ]
+                            },
+                            headers={"Accept": wire.FRAME_CONTENT_TYPE},
+                        )
+                        await resp.read()
+                        assert resp.status == 200, f"HTTP {resp.status}"
+                        lats.append(time.perf_counter() - t0)
+
+                # warm both paths
+                _os.environ[wire.WIRE_CRC_ENV] = "0"
+                await slice_requests(c_off, [])
+                _os.environ[wire.WIRE_CRC_ENV] = "1"
+                await slice_requests(c_on, [])
+                for r in range(args.gray_overhead_rounds):
+                    order = (
+                        (False, True) if r % 2 == 0 else (True, False)
+                    )
+                    pair: dict[bool, list[float]] = {False: [], True: []}
+                    for armed in order:
+                        # the env steers the REPLICA encoding per slice;
+                        # each app captured its validator at build
+                        _os.environ[wire.WIRE_CRC_ENV] = (
+                            "1" if armed else "0"
+                        )
+                        await slice_requests(
+                            c_on if armed else c_off, pair[armed]
+                        )
+                    off.extend(pair[False])
+                    on.extend(pair[True])
+                    off_p50 = float(np.median(pair[False]))
+                    on_p50 = float(np.median(pair[True]))
+                    if off_p50 > 0:
+                        paired.append((on_p50 - off_p50) / off_p50 * 100.0)
+        finally:
+            _os.environ.pop(wire.WIRE_CRC_ENV, None)
+        await pool_off.stop()
+        await pool_on.stop()
+        await teardown(dets, servers)
+        return {
+            "p50_off_ms": float(np.median(off)) * 1e3,
+            "p50_on_ms": float(np.median(on)) * 1e3,
+            "paired_deltas_pct": paired,
+            "delta_pct": float(np.median(paired)) if paired else 0.0,
+        }
+
+    storm = asyncio.run(storm_and_corrupt())
+    ovh = asyncio.run(overhead())
+
+    gates = {
+        "recovery_within_10s": (
+            storm["recovery_s"] is not None
+            and storm["recovery_s"] <= recovery_gate_s
+        ),
+        "gray_share_under_5pct": storm["gray_share"] < share_gate,
+        "zero_client_failures": storm["client_failures"] == 0,
+        "corrupt_frames_replayed": (
+            storm["corrupt_replayed"] >= storm["corrupt_injected"] > 0
+        ),
+        "zero_corrupt_client_errors": storm["corrupt_client_errors"] == 0,
+        "overhead_under_1pct": ovh["delta_pct"] < overhead_gate_pct,
+    }
+    passed = all(gates.values())
+    recovery_value = (
+        storm["recovery_s"] if storm["recovery_s"] is not None else storm_s
+    )
+    print(
+        f"# gray-storm: 1 of {n_replicas} replicas {factor:.0f}x-slow "
+        f"mid-load ({storm['requests']} reqs, concurrency {concurrency}): "
+        f"baseline p99 {storm['baseline_p99_ms']:.1f} ms, recovery "
+        f"{'%.2f s' % storm['recovery_s'] if storm['recovery_s'] is not None else 'NONE'}"
+        f" (gate {recovery_gate_s:.0f} s at <= {p99_gate_ratio}x), gray "
+        f"share {storm['gray_share'] * 100:.2f}% (gate < 5%), failures "
+        f"{storm['client_failures']}, hedges {storm['hedges']} "
+        f"({storm['hedge_wins']} wins), soft ejections "
+        f"{storm['soft_ejections']} (state={storm['gray_state']}); corrupt "
+        f"frames {storm['corrupt_replayed']}/{storm['corrupt_injected']} "
+        f"replayed with {storm['corrupt_client_errors']} client errors; "
+        f"unloaded immune-plane overhead {ovh['delta_pct']:+.2f}% p50 "
+        f"(off {ovh['p50_off_ms']:.3f} -> on {ovh['p50_on_ms']:.3f} ms) "
+        f"over {len(ovh['paired_deltas_pct'])} paired rounds",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"gray-storm fleet p99 recovery: 1 of {n_replicas} stub "
+            f"replicas turned {factor:.0f}x-slow mid-load behind the real "
+            f"router+pool (adaptive hedging + outlier soft-ejection + "
+            f"frame CRC; gates: recovery <= {recovery_gate_s:.0f} s at "
+            f"<= {p99_gate_ratio}x baseline p99, gray share < 5%, 0 "
+            f"client failures, corrupt frames replayed, unloaded "
+            f"overhead < 1% p50)"
+        ),
+        "value": round(float(recovery_value), 3),
+        "unit": "seconds",
+        "vs_baseline": None,
+        "baseline_p99_ms": round(storm["baseline_p99_ms"], 3),
+        "p99_windows_after_gray": [
+            [round(t, 2), round(p, 1)] for t, p in storm["windows"]
+        ],
+        "gray_share_pct": round(storm["gray_share"] * 100, 3),
+        "client_failures": storm["client_failures"],
+        "hedges_total": storm["hedges"],
+        "hedge_wins_total": storm["hedge_wins"],
+        "soft_ejections_total": storm["soft_ejections"],
+        "gray_replica_state": storm["gray_state"],
+        "corrupt_injected": storm["corrupt_injected"],
+        "corrupt_replayed": storm["corrupt_replayed"],
+        "corrupt_client_errors": storm["corrupt_client_errors"],
+        "overhead_delta_pct": round(ovh["delta_pct"], 3),
+        "overhead_p50_off_ms": round(ovh["p50_off_ms"], 3),
+        "overhead_p50_on_ms": round(ovh["p50_on_ms"], 3),
+        "overhead_paired_deltas_pct": [
+            round(d, 3) for d in ovh["paired_deltas_pct"]
+        ],
+        "gates": gates,
+        "pass": passed,
+    }
+    print(json.dumps(result))
+    return 0 if passed else 1
+
+
 def cache_bench(args) -> int:
     """Caching tier, measured not asserted (ISSUE 5 + ISSUE 11): the REAL
     detector + MicroBatcher + result-cache/coalescing plumbing under a
@@ -2820,6 +3189,42 @@ def main() -> int:
         "this class of box; 50 ms cadence = 9%% of a core)",
     )
     parser.add_argument(
+        "--gray-storm",
+        action="store_true",
+        help="run the gray-failure immunity bench instead (CPU ok, "
+        "model-free): 1-of-N stub replicas turned 10x-slow mid-load "
+        "behind the real router+pool with adaptive hedging, outlier "
+        "soft-ejection, and frame CRC armed; gates p99 recovery, gray "
+        "traffic share, zero client failures, corrupt-frame replay, and "
+        "the unloaded immune-plane overhead; exits non-zero when any "
+        "gate fails",
+    )
+    parser.add_argument("--gray-replicas", type=int, default=4)
+    # 20 ms stub service ~ a realistic replica pace (the --fleet-obs
+    # calibration); the gray replica serves at factor x this
+    parser.add_argument("--gray-service-ms", type=float, default=20.0)
+    parser.add_argument("--gray-concurrency", type=int, default=8)
+    parser.add_argument("--gray-factor", type=float, default=10.0)
+    parser.add_argument("--gray-baseline-s", type=float, default=3.0)
+    parser.add_argument(
+        "--gray-storm-s", type=float, default=12.0,
+        help="load window after the gray injection; the 10 s recovery "
+        "gate needs head room inside it",
+    )
+    parser.add_argument(
+        "--gray-share-window-s", type=float, default=3.0,
+        help="trailing window for the gray replica's steady-state "
+        "traffic-share gate",
+    )
+    parser.add_argument("--gray-corrupt-frames", type=int, default=5)
+    parser.add_argument("--gray-corrupt-requests", type=int, default=60)
+    parser.add_argument(
+        "--gray-overhead-requests", type=int, default=50,
+        help="sequential requests per overhead slice (the --fleet-obs "
+        "short-slice protocol)",
+    )
+    parser.add_argument("--gray-overhead-rounds", type=int, default=8)
+    parser.add_argument(
         "--tp",
         action="store_true",
         help="run the tensor-parallel serving bench instead (CPU ok over "
@@ -2878,6 +3283,8 @@ def main() -> int:
         return perf_overhead_bench(args)
     if args.fleet_obs:
         return fleet_obs_bench(args)
+    if args.gray_storm:
+        return gray_storm_bench(args)
     if args.failover:
         return failover_bench(args)
     if args.preemption_storm:

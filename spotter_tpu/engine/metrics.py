@@ -380,6 +380,13 @@ class Metrics:
             if generation is not None:
                 self._generation = int(generation)
 
+    @property
+    def replica_id(self) -> str:
+        """The identity stamp's replica id (ISSUE 14 satellite: echoed as
+        the X-Spotter-Replica response header at replica and edge)."""
+        with self._lock:
+            return self._replica_id
+
     def set_admit_state(self, limit: int, in_flight: int) -> None:
         """The AIMD limiter publishes its state on every control tick."""
         with self._lock:

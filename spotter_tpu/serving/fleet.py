@@ -60,6 +60,7 @@ from spotter_tpu import obs
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.obs.aggregate import FleetAggregator
+from spotter_tpu.serving import wire
 from spotter_tpu.serving.replica_pool import (
     PoolExhaustedError,
     ReplicaPool,
@@ -752,6 +753,9 @@ def make_fleet_app(
                 body=resp.content,
                 content_type="application/json",
             )
+            rid = resp.headers.get(wire.REPLICA_HEADER)
+            if rid:  # replica identity rides through the fleet edge too
+                out.headers[wire.REPLICA_HEADER] = rid
         return done(out)
 
     async def healthz(request: web.Request) -> web.Response:

@@ -13,36 +13,67 @@ in one file:
 - **Outlier ejection**: `eject_threshold` consecutive transport failures
   eject a replica for an exponentially growing backoff (doubling up to
   `backoff_max_s`); a later health-check success resets it.
+- **Gray-failure scoring + soft ejection** (ISSUE 14): hard ejection only
+  fires on transport FAILURES, so a replica that answers /healthz but
+  serves 10x slow — spot-VM throttling, a noisy neighbor (Spotlight's
+  gray-failure signature) — used to poison fleet p99 indefinitely. Every
+  replica now carries two latency EWMAs (request latency and health-probe
+  latency; the probe one means a silent-slow replica is detected with ZERO
+  traffic) compared against the pool median of the same kind: a score of
+  `ewma / median`, taking the worse of the two kinds. A score past
+  `SPOTTER_TPU_OUTLIER_RATIO` soft-ejects the replica — it stays in the
+  ring but its selection weight drops to `SPOTTER_TPU_OUTLIER_WEIGHT`
+  (default 5%), in both the round-robin path (smooth weighted RR) and the
+  cache-affinity `prefer` path (deterministic thinning: the gray owner
+  keeps a weight-sized trickle of its keyed traffic, the rest falls to the
+  next-ranked holder). The trickle plus the probes keep the EWMAs honest;
+  once the score recovers under the restore ratio the replica enters a
+  CANARY state (quarter weight) and only returns to full weight after
+  `canary_ok` consecutive good responses — no binary eject flap. The last
+  available non-gray replica is never soft-ejected, and scores below an
+  absolute floor (`SPOTTER_TPU_OUTLIER_MIN_MS`) never trip it, so
+  microsecond-noise on a fast fleet cannot manufacture outliers.
 - **Replay**: a `/detect` attempt that dies on a transport error
-  (connection reset — the signature of a killed replica), times out, or
-  answers 5xx/429 is replayed against the next replica. Detection is
-  idempotent, so replay is safe; the client sees one answer, not the
-  preemption. Replays spend from a `RetryBudget` (ISSUE 6): a correlated
-  failure — a preemption storm taking half the fleet — must not amplify
-  offered load with unbudgeted retries, so replays in a sliding window are
-  capped at `SPOTTER_TPU_RETRY_BUDGET_PCT` of the recent request count
-  (with a small floor so single-replica deaths still fail over cleanly);
-  an exhausted budget fails the request FAST with a 503-shaped error
-  instead of piling more attempts onto survivors.
+  (connection reset — the signature of a killed replica), times out,
+  answers 5xx/429, or fails the caller's response `validator` (a corrupt
+  binary frame — wire.py CRC, ISSUE 14) is replayed against the next
+  replica. Detection is idempotent, so replay is safe; the client sees one
+  answer, not the preemption. Replays spend from a `RetryBudget` (ISSUE 6):
+  a correlated failure — a preemption storm taking half the fleet — must
+  not amplify offered load with unbudgeted retries, so replays in a sliding
+  window are capped at `SPOTTER_TPU_RETRY_BUDGET_PCT` of the recent request
+  count (with a small floor so single-replica deaths still fail over
+  cleanly); an exhausted budget fails the request FAST with a 503-shaped
+  error instead of piling more attempts onto survivors.
 - **Fast-fail when suspended** (ISSUE 6 bugfix): when every replica is
   ejected or health-marked down — or the pool is empty because its tier
   scaled to zero — `request()` raises `PoolSuspendedError` immediately
   (with a Retry-After hint derived from the soonest un-ejection) instead of
   burning the client's whole deadline on a candidate set that cannot serve.
-- **Hedging** (optional): after `hedge_after_s` with no answer, a duplicate
-  fires at a second replica and the first response wins — the tail-latency
-  insurance for a replica that is technically alive but drowning. Hedges
-  are bounded by their own counters and do NOT spend retry budget: they are
-  latency insurance against a live replica, not recovery from a dead one.
+- **Budgeted adaptive hedging** (ISSUE 14, upgrading the ISSUE 2 fixed
+  timer): with `adaptive_hedge=True` the hedge trigger is the live pool
+  p95 (a sliding window of observed request latencies) instead of a static
+  `hedge_after_s` — the timer tracks what "slow" means for THIS pool under
+  THIS load. Hedge spend is capped by a sliding-window hedge budget
+  (`SPOTTER_TPU_HEDGE_BUDGET_PCT` of recent requests, floor
+  `SPOTTER_TPU_HEDGE_BUDGET_MIN`) exactly like the retry budget: an
+  exhausted budget falls back to un-hedged waiting (never an error).
+  The losing attempt is CANCELLED (the underlying HTTP request torn down,
+  awaited to completion) and excluded from breaker/ejection counts — a
+  cancelled loser is the hedge's fault, not the replica's — though its
+  elapsed time does feed the loser's latency EWMA, so chronic hedge losers
+  converge to gray.
 
 Membership is dynamic (`add_endpoint` / `remove_endpoint`): the fleet
 controller (serving/fleet.py) grows and shrinks pools as spot capacity
 churns and idle tiers scale to zero.
 
-`bench.py --failover` drives this pool; `python -m spotter_tpu.serving.router`
-runs it as a tiny edge router. Counters surface in `snapshot()` (and the
-router's /metrics): ejections, replays, hedges, budget exhaustions,
-client-visible failures.
+`bench.py --failover` and `bench.py --gray-storm` drive this pool;
+`python -m spotter_tpu.serving.router` runs it as a tiny edge router.
+Counters surface in `snapshot()` (and the router's /metrics): ejections,
+soft ejections/restores, replays, hedges (+ budget exhaustions and loser
+cancellations), invalid responses, budget exhaustions, client-visible
+failures.
 """
 
 import asyncio
@@ -55,6 +86,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 import httpx
+
+from spotter_tpu.serving.resilience import Ewma
 
 logger = logging.getLogger(__name__)
 
@@ -73,9 +106,70 @@ DEFAULT_RETRY_BUDGET_PCT = 10.0
 DEFAULT_RETRY_BUDGET_MIN = 10
 DEFAULT_RETRY_BUDGET_WINDOW_S = 30.0
 
+# Gray-failure outlier scoring (ISSUE 14). Ratios are against the pool
+# median of the same latency kind; the restore ratio sits well under the
+# trip ratio (hysteresis) so a replica hovering at the boundary doesn't
+# flap between full and thinned weight.
+OUTLIER_RATIO_ENV = "SPOTTER_TPU_OUTLIER_RATIO"
+OUTLIER_RESTORE_RATIO_ENV = "SPOTTER_TPU_OUTLIER_RESTORE_RATIO"
+OUTLIER_ALPHA_ENV = "SPOTTER_TPU_OUTLIER_ALPHA"
+OUTLIER_WEIGHT_ENV = "SPOTTER_TPU_OUTLIER_WEIGHT"
+OUTLIER_MIN_SAMPLES_ENV = "SPOTTER_TPU_OUTLIER_MIN_SAMPLES"
+OUTLIER_MIN_MS_ENV = "SPOTTER_TPU_OUTLIER_MIN_MS"
+DEFAULT_OUTLIER_RATIO = 3.0  # <= 0 disables the scorer entirely
+DEFAULT_OUTLIER_RESTORE_RATIO = 1.5
+DEFAULT_OUTLIER_ALPHA = 0.3
+DEFAULT_OUTLIER_WEIGHT = 0.05  # gray replica's traffic share
+DEFAULT_OUTLIER_MIN_SAMPLES = 8
+DEFAULT_OUTLIER_MIN_MS = 20.0  # below this an EWMA can never be an outlier
+CANARY_WEIGHT = 0.25  # re-probe share while confirming recovery
+CANARY_OK_REQUIRED = 3  # consecutive good canary responses to restore
+
+# replica outlier states
+OUTLIER_OK = "ok"
+OUTLIER_GRAY = "gray"
+OUTLIER_CANARY = "canary"
+
+# Budgeted adaptive hedging (ISSUE 14)
+HEDGE_BUDGET_PCT_ENV = "SPOTTER_TPU_HEDGE_BUDGET_PCT"
+HEDGE_BUDGET_MIN_ENV = "SPOTTER_TPU_HEDGE_BUDGET_MIN"
+DEFAULT_HEDGE_BUDGET_PCT = 10.0
+DEFAULT_HEDGE_BUDGET_MIN = 5
+DEFAULT_HEDGE_QUANTILE = 0.95
+# adaptive trigger needs this many windowed samples before the observed
+# quantile is trusted; colder pools fall back to the static timer (if any)
+HEDGE_MIN_SAMPLES = 20
+HEDGE_WINDOW = 512  # sliding sample window behind the adaptive trigger
+# The trigger is floored at this multiple of the observed p50: on a TIGHT
+# latency distribution the p95 sits just above typical, so a bare-quantile
+# trigger would hedge ~5% of perfectly healthy requests by construction —
+# pure duplicate load for zero tail win (measured +1.3% unloaded p50).
+# Hedging only pays when the tail is DETACHED from typical (a drowning
+# replica), which is exactly tail >= 2x p50.
+HEDGE_MIN_P50_RATIO = 2.0
+# the sorted-window quantile is recomputed at most every this many new
+# samples (a 512-float sort per request is measurable at 20 ms services)
+_HEDGE_RECOMPUTE_EVERY = 16
+
 # statuses that mean "this replica can't serve it right now, another might":
 # 429 queue-full, 503 draining/breaker, 500 engine fault
 REPLAYABLE_STATUSES = frozenset({429, 500, 502, 503})
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
 
 
 class PoolExhaustedError(RuntimeError):
@@ -101,7 +195,9 @@ class RetryBudget:
     last `window_s` seconds are capped at max(`min_retries`,
     `pct`% of requests seen in the same window). Shared budgets are fine —
     the fleet controller gives each pool its own slice so a bulk-tier storm
-    cannot starve SLO-tier failover.
+    cannot starve SLO-tier failover. The hedge budget (ISSUE 14) is a
+    second instance of this same class over its own knobs: hedges are
+    deliberate load amplification too, just cheaper per event.
     """
 
     def __init__(
@@ -124,6 +220,17 @@ class RetryBudget:
         self._requests: deque[float] = deque()
         self._retries: deque[float] = deque()
         self.exhausted_total = 0
+
+    @classmethod
+    def for_hedging(cls, clock: Callable[[], float] = time.monotonic) -> "RetryBudget":
+        """The hedge-spend budget from its own env knobs (ISSUE 14)."""
+        return cls(
+            pct=_env_float(HEDGE_BUDGET_PCT_ENV, DEFAULT_HEDGE_BUDGET_PCT),
+            min_retries=_env_int(
+                HEDGE_BUDGET_MIN_ENV, DEFAULT_HEDGE_BUDGET_MIN
+            ),
+            clock=clock,
+        )
 
     def _trim(self, now: float) -> None:
         horizon = now - self.window_s
@@ -176,6 +283,17 @@ class Replica:
     consecutive_failures: int = 0
     ejected_until: float = 0.0
     eject_backoff_s: float = 0.0
+    # gray-failure scoring state (ISSUE 14): request-latency and
+    # probe-latency EWMAs, the score vs the pool median, the soft-eject
+    # state machine, and the deterministic weighted-selection accumulators
+    req_ewma: Ewma = field(default_factory=Ewma)
+    probe_ewma: Ewma = field(default_factory=Ewma)
+    outlier_state: str = OUTLIER_OK
+    outlier_score: float = 0.0
+    canary_ok: int = 0
+    soft_ejections: int = 0
+    wrr_credit: float = 0.0  # smooth weighted round-robin accumulator
+    prefer_credit: float = 0.0  # affinity-path thinning accumulator
     # diagnostics
     requests: int = 0
     failures: int = 0
@@ -185,6 +303,16 @@ class Replica:
 
     def available(self, now: float) -> bool:
         return self.healthy and now >= self.ejected_until
+
+
+def _median(values: list[float]) -> Optional[float]:
+    if not values:
+        return None
+    vals = sorted(values)
+    n = len(vals)
+    if n % 2:
+        return vals[n // 2]
+    return 0.5 * (vals[n // 2 - 1] + vals[n // 2])
 
 
 class ReplicaPool:
@@ -198,14 +326,22 @@ class ReplicaPool:
         health_interval_s: float = DEFAULT_HEALTH_INTERVAL_S,
         request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
         hedge_after_s: Optional[float] = None,
+        adaptive_hedge: bool = False,
+        hedge_quantile: float = DEFAULT_HEDGE_QUANTILE,
+        hedge_budget: Optional[RetryBudget] = None,
         max_rounds: int = 2,
         round_pause_s: float = 0.25,
         retry_budget: Optional[RetryBudget] = None,
+        outlier_ratio: Optional[float] = None,
+        outlier_restore_ratio: Optional[float] = None,
+        outlier_alpha: Optional[float] = None,
+        outlier_weight: Optional[float] = None,
+        outlier_min_samples: Optional[int] = None,
+        outlier_min_ms: Optional[float] = None,
         allow_empty: bool = False,
     ) -> None:
         if not endpoints and not allow_empty:
             raise ValueError("ReplicaPool needs at least one endpoint")
-        self.replicas = [Replica(url=u.rstrip("/")) for u in endpoints]
         self.retry_budget = retry_budget or RetryBudget()
         self.client = client or httpx.AsyncClient(
             timeout=httpx.Timeout(request_timeout_s, connect=2.0)
@@ -215,19 +351,67 @@ class ReplicaPool:
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self.health_interval_s = health_interval_s
+        # hedging (ISSUE 2 static timer; ISSUE 14 adaptive trigger + budget)
         self.hedge_after_s = hedge_after_s
+        self.adaptive_hedge = adaptive_hedge
+        self.hedge_quantile = min(max(hedge_quantile, 0.5), 0.999)
+        self.hedge_budget = hedge_budget or RetryBudget.for_hedging()
+        self._lat_window: deque[float] = deque(maxlen=HEDGE_WINDOW)
+        self._lat_samples = 0
+        self._hedge_trigger_cache: Optional[float] = None
+        self._hedge_trigger_at = 0
+        # gray-failure scoring knobs (ISSUE 14); ratio <= 0 disables
+        if outlier_ratio is None:
+            outlier_ratio = _env_float(OUTLIER_RATIO_ENV, DEFAULT_OUTLIER_RATIO)
+        if outlier_restore_ratio is None:
+            outlier_restore_ratio = _env_float(
+                OUTLIER_RESTORE_RATIO_ENV, DEFAULT_OUTLIER_RESTORE_RATIO
+            )
+        if outlier_alpha is None:
+            outlier_alpha = _env_float(OUTLIER_ALPHA_ENV, DEFAULT_OUTLIER_ALPHA)
+        if outlier_weight is None:
+            outlier_weight = _env_float(
+                OUTLIER_WEIGHT_ENV, DEFAULT_OUTLIER_WEIGHT
+            )
+        if outlier_min_samples is None:
+            outlier_min_samples = _env_int(
+                OUTLIER_MIN_SAMPLES_ENV, DEFAULT_OUTLIER_MIN_SAMPLES
+            )
+        if outlier_min_ms is None:
+            outlier_min_ms = _env_float(
+                OUTLIER_MIN_MS_ENV, DEFAULT_OUTLIER_MIN_MS
+            )
+        self.outlier_ratio = float(outlier_ratio)
+        self.outlier_restore_ratio = min(
+            float(outlier_restore_ratio), max(self.outlier_ratio, 0.0)
+        )
+        self.outlier_alpha = float(outlier_alpha)
+        self.outlier_weight = min(max(float(outlier_weight), 0.001), 1.0)
+        self.outlier_min_samples = max(int(outlier_min_samples), 2)
+        self.outlier_min_ms = max(float(outlier_min_ms), 0.0)
         self.max_rounds = max(1, max_rounds)
         self.round_pause_s = round_pause_s
         self._rr = itertools.count()
         self._health_task: Optional[asyncio.Task] = None
+        self.replicas = [self._new_replica(u.rstrip("/")) for u in endpoints]
         # counters (event-loop only — no lock needed)
         self.requests_total = 0
         self.replays_total = 0
         self.hedges_total = 0
         self.hedge_wins_total = 0
+        self.hedge_cancels_total = 0
         self.ejections_total = 0
+        self.soft_ejections_total = 0
+        self.soft_restores_total = 0
+        self.invalid_responses_total = 0  # validator rejections (frame CRC)
         self.failures_total = 0  # client-visible (pool exhausted)
         self.suspended_total = 0  # fast-failed: nothing worth trying
+
+    def _new_replica(self, url: str, healthy: bool = True) -> Replica:
+        r = Replica(url=url, healthy=healthy)
+        r.req_ewma = Ewma(self.outlier_alpha)
+        r.probe_ewma = Ewma(self.outlier_alpha)
+        return r
 
     # ---- membership (fleet controller: spot churn, scale-to-zero) ----
 
@@ -239,7 +423,7 @@ class ReplicaPool:
         existing = self.replica_for(url)
         if existing is not None:
             return existing
-        r = Replica(url=url, healthy=healthy)
+        r = self._new_replica(url, healthy=healthy)
         self.replicas.append(r)
         return r
 
@@ -281,6 +465,7 @@ class ReplicaPool:
     # ---- health ----
 
     async def _probe(self, r: Replica) -> None:
+        t0 = time.monotonic()
         try:
             resp = await self.client.get(f"{r.url}/healthz", timeout=2.0)
             ok = resp.status_code == 200
@@ -289,7 +474,14 @@ class ReplicaPool:
             r.last_error = f"health: {exc!r}"
         if not ok:
             r.healthy = False
-        elif not r.available(time.monotonic()):
+            return
+        # probe latency feeds the gray-failure score (ISSUE 14 satellite:
+        # it used to be measured and discarded) — a replica whose event
+        # loop is starved answers /healthz slow long before live traffic
+        # would show it, so a silent-slow replica is flagged with ZERO
+        # /detect traffic
+        self._observe_latency(r, (time.monotonic() - t0) * 1e3, probe=True)
+        if not r.available(time.monotonic()):
             # only an UNAVAILABLE replica is promoted by a probe success; on
             # an available one the success is a no-op so probes cannot reset
             # the consecutive-failure count live traffic is accumulating
@@ -331,6 +523,131 @@ class ReplicaPool:
                 r.url, r.eject_backoff_s, r.consecutive_failures, err,
             )
 
+    # ---- gray-failure scoring (ISSUE 14) ----
+
+    def _observe_latency(
+        self, r: Replica, ms: float, probe: bool = False, window: bool = True
+    ) -> None:
+        """One latency observation for `r`: update the kind's EWMA, feed
+        the pool-wide hedge-trigger window (request latencies only), count
+        canary evidence, and re-run the outlier state machine."""
+        if probe:
+            r.probe_ewma.update(ms)
+        else:
+            r.req_ewma.update(ms)
+            if window:
+                self._lat_window.append(ms)
+                self._lat_samples += 1
+            if r.outlier_state == OUTLIER_CANARY:
+                r.canary_ok += 1
+        if self.outlier_ratio > 0:
+            self._update_outliers()
+
+    def _outlier_score(
+        self,
+        r: Replica,
+        med_req: Optional[float],
+        med_probe: Optional[float],
+    ) -> float:
+        """`ewma / pool median`, the worse of the request and probe kinds.
+        A kind contributes only with enough samples AND an EWMA above the
+        absolute floor — a 0.3 ms probe against a 0.1 ms median is noise,
+        not a gray failure."""
+        score = 0.0
+        if (
+            med_req
+            and r.req_ewma.samples >= self.outlier_min_samples
+            and r.req_ewma.value >= self.outlier_min_ms
+        ):
+            score = r.req_ewma.value / med_req
+        if (
+            med_probe
+            and r.probe_ewma.samples >= self.outlier_min_samples
+            and r.probe_ewma.value >= self.outlier_min_ms
+        ):
+            score = max(score, r.probe_ewma.value / med_probe)
+        return score
+
+    def _update_outliers(self) -> None:
+        """Recompute every replica's score against the pool medians and run
+        the soft-ejection state machine:
+
+            ok ---(score >= ratio, peers exist)--> gray (weight-down)
+            gray --(score <= restore ratio)------> canary (quarter weight)
+            canary --(CANARY_OK good responses)--> ok (full restore)
+            canary --(score >= ratio again)------> gray
+
+        The medians need at least two contributing replicas — with one
+        member there is no peer to be slower than."""
+        req_vals = [
+            r.req_ewma.value
+            for r in self.replicas
+            if r.req_ewma.samples >= self.outlier_min_samples
+        ]
+        probe_vals = [
+            r.probe_ewma.value
+            for r in self.replicas
+            if r.probe_ewma.samples >= self.outlier_min_samples
+        ]
+        med_req = _median(req_vals) if len(req_vals) >= 2 else None
+        med_probe = _median(probe_vals) if len(probe_vals) >= 2 else None
+        if not med_req and not med_probe:
+            return
+        now = time.monotonic()
+        for r in self.replicas:
+            score = self._outlier_score(r, med_req, med_probe)
+            r.outlier_score = score
+            if r.outlier_state == OUTLIER_OK:
+                if score >= self.outlier_ratio:
+                    # never soft-eject the last non-gray available replica:
+                    # a thinned pool of one is just a slower pool of one
+                    peers = sum(
+                        1
+                        for o in self.replicas
+                        if o is not r
+                        and o.available(now)
+                        and o.outlier_state != OUTLIER_GRAY
+                    )
+                    if peers >= 1:
+                        r.outlier_state = OUTLIER_GRAY
+                        r.canary_ok = 0
+                        r.soft_ejections += 1
+                        self.soft_ejections_total += 1
+                        logger.warning(
+                            "replica %s soft-ejected (gray): latency score "
+                            "%.2fx pool median (req %.1f ms, probe %.1f ms)",
+                            r.url, score, r.req_ewma.value, r.probe_ewma.value,
+                        )
+            elif r.outlier_state == OUTLIER_GRAY:
+                if score <= self.outlier_restore_ratio:
+                    r.outlier_state = OUTLIER_CANARY
+                    r.canary_ok = 0
+                    logger.info(
+                        "replica %s score recovered (%.2fx): canary re-probe",
+                        r.url, score,
+                    )
+            elif r.outlier_state == OUTLIER_CANARY:
+                if score >= self.outlier_ratio:
+                    r.outlier_state = OUTLIER_GRAY
+                    r.canary_ok = 0
+                elif (
+                    score <= self.outlier_restore_ratio
+                    and r.canary_ok >= CANARY_OK_REQUIRED
+                ):
+                    r.outlier_state = OUTLIER_OK
+                    self.soft_restores_total += 1
+                    logger.info(
+                        "replica %s restored to full weight after %d good "
+                        "canary responses", r.url, r.canary_ok,
+                    )
+
+    def _weight(self, r: Replica) -> float:
+        if r.outlier_state == OUTLIER_GRAY:
+            return self.outlier_weight
+        if r.outlier_state == OUTLIER_CANARY:
+            return CANARY_WEIGHT
+        return 1.0
+
     # ---- routing ----
 
     def _pick(
@@ -340,24 +657,52 @@ class ReplicaPool:
         is a ranked candidate order — the rendezvous ring's weight ordering
         for this request's key: the first AVAILABLE preferred replica wins,
         so a dead/ejected/draining owner deterministically falls to the
-        next-highest-weight holder instead of a random survivor. With the
-        preference order exhausted (or absent) selection is the original
-        round-robin over whatever is left."""
+        next-highest-weight holder instead of a random survivor. A
+        soft-ejected (gray/canary) preferred holder is THINNED, not
+        skipped: a deterministic credit accumulator gives it its weight's
+        share of its keyed traffic (the canary trickle that lets its EWMA
+        recover) and hands the rest to the next-ranked holder. With the
+        preference order exhausted (or absent) selection is round-robin
+        while every candidate is at full weight, else smooth weighted
+        round-robin over the outlier weights."""
         now = time.monotonic()
         if prefer:
             for url in prefer:
                 if url in exclude:
                     continue
                 r = self.replica_for(url)
-                if r is not None and r.available(now):
+                if r is None or not r.available(now):
+                    continue
+                w = self._weight(r)
+                if w >= 1.0:
                     return r
+                r.prefer_credit += w
+                if r.prefer_credit >= 1.0:
+                    r.prefer_credit -= 1.0
+                    return r
+                # thinned away this time: fall to the next-ranked holder
         candidates = [
             r for r in self.replicas
             if r.url not in exclude and r.available(now)
         ]
         if not candidates:
             return None
-        return candidates[next(self._rr) % len(candidates)]
+        if all(r.outlier_state == OUTLIER_OK for r in candidates):
+            # the pre-ISSUE-14 behavior, bit-identical while nothing is gray
+            return candidates[next(self._rr) % len(candidates)]
+        # smooth weighted round-robin (the nginx algorithm): deterministic,
+        # proportional to weight, and maximally spread — no RNG in routing
+        total = 0.0
+        best: Optional[Replica] = None
+        for r in candidates:
+            w = self._weight(r)
+            total += w
+            r.wrr_credit += w
+            if best is None or r.wrr_credit > best.wrr_credit:
+                best = r
+        assert best is not None
+        best.wrr_credit -= total
+        return best
 
     def _raise_if_suspended(self) -> None:
         """Fail fast when nothing is worth trying: the pool is empty (scaled
@@ -389,12 +734,49 @@ class ReplicaPool:
     async def _attempt(
         self, r: Replica, path: str, payload: dict,
         headers: Optional[dict] = None,
+        validator: Optional[Callable] = None,
     ):
         r.requests += 1
+        t0 = time.monotonic()
         resp = await self.client.post(
             f"{r.url}{path}", json=payload, headers=headers
         )
+        if validator is not None and resp.status_code == 200:
+            # wire-integrity check (ISSUE 14): a 200 whose body fails the
+            # caller's validator (corrupt frame CRC) is a transport-shaped
+            # failure — the raise feeds ejection counts and the replay
+            # loop, exactly like a connection reset, and the client never
+            # sees it
+            try:
+                validator(resp)
+            except Exception:
+                self.invalid_responses_total += 1
+                raise
+        if resp.status_code not in REPLAYABLE_STATUSES:
+            self._observe_latency(r, (time.monotonic() - t0) * 1e3)
         return resp
+
+    def _hedge_trigger_s(self) -> Optional[float]:
+        """When to fire the hedge: the live pool quantile once the window
+        is warm (adaptive mode), else the static timer. None = no hedging.
+        The adaptive trigger is floored at HEDGE_MIN_P50_RATIO x the
+        observed p50 (see the constant) and cached between recomputes."""
+        if self.adaptive_hedge and len(self._lat_window) >= HEDGE_MIN_SAMPLES:
+            if (
+                self._hedge_trigger_cache is None
+                or self._lat_samples - self._hedge_trigger_at
+                >= _HEDGE_RECOMPUTE_EVERY
+            ):
+                lats = sorted(self._lat_window)
+                n = len(lats)
+                q = lats[min(int(self.hedge_quantile * n), n - 1)]
+                p50 = lats[n // 2]
+                self._hedge_trigger_cache = max(
+                    q, HEDGE_MIN_P50_RATIO * p50, 1.0
+                ) / 1000.0
+                self._hedge_trigger_at = self._lat_samples
+            return self._hedge_trigger_cache
+        return self.hedge_after_s
 
     async def request(
         self,
@@ -402,21 +784,28 @@ class ReplicaPool:
         payload: dict,
         headers: Optional[dict] = None,
         prefer: Optional[list[str]] = None,
+        validator: Optional[Callable] = None,
     ) -> httpx.Response:
         """POST `payload` with failover: try each distinct replica at most
-        once per round, replaying on transport errors and replayable
-        statuses; after a fully-failed round, pause briefly and run up to
-        `max_rounds - 1` more (a preemption that takes the whole pool down
-        for a beat — e.g. both replicas mid-drain — should cost the client
-        milliseconds, not an error). Every attempt after the first spends
-        from the retry budget; an exhausted budget raises
-        RetryBudgetExhaustedError rather than amplifying a correlated
-        failure. A pool with NO available replica fails fast with
-        PoolSuspendedError (503 + Retry-After at the router) instead of
-        waiting out the request deadline. Raises PoolExhaustedError when
-        every round exhausted every replica."""
+        once per round, replaying on transport errors, replayable statuses,
+        and validator rejections (corrupt frames); after a fully-failed
+        round, pause briefly and run up to `max_rounds - 1` more (a
+        preemption that takes the whole pool down for a beat — e.g. both
+        replicas mid-drain — should cost the client milliseconds, not an
+        error). Every attempt after the first spends from the retry budget;
+        an exhausted budget raises RetryBudgetExhaustedError rather than
+        amplifying a correlated failure. A pool with NO available replica
+        fails fast with PoolSuspendedError (503 + Retry-After at the
+        router) instead of waiting out the request deadline. Raises
+        PoolExhaustedError when every round exhausted every replica.
+
+        `validator` (optional) is called on every 200 response body BEFORE
+        it is accepted; a raise is treated as a transport failure of that
+        replica (counted in `invalid_responses_total`, replayed against the
+        next ranked holder) — the wire-integrity hook (ISSUE 14)."""
         self.requests_total += 1
         self.retry_budget.record_request()
+        self.hedge_budget.record_request()
         self._raise_if_suspended()
         last_err = ""
         first_attempt = True
@@ -448,13 +837,17 @@ class ReplicaPool:
                 first_attempt = False
                 tried.add(r.url)
                 try:
-                    if self.hedge_after_s is not None and attempt == 0:
+                    trigger_s = self._hedge_trigger_s()
+                    if trigger_s is not None and attempt == 0:
                         resp = await self._hedged_attempt(
-                            r, tried, path, payload, headers, prefer
+                            r, tried, path, payload, headers, prefer,
+                            trigger_s, validator,
                         )
                     else:
-                        resp = await self._attempt(r, path, payload, headers)
-                except Exception as exc:  # connect/reset/timeout — kill signature
+                        resp = await self._attempt(
+                            r, path, payload, headers, validator
+                        )
+                except Exception as exc:  # connect/reset/timeout/corrupt
                     self._record_failure(r, repr(exc))
                     last_err = f"{r.url}: {exc!r}"
                     continue
@@ -477,21 +870,34 @@ class ReplicaPool:
     async def _hedged_attempt(
         self, first: Replica, tried: set[str], path: str, payload: dict,
         headers: Optional[dict] = None, prefer: Optional[list[str]] = None,
+        trigger_s: float = 0.0, validator: Optional[Callable] = None,
     ) -> httpx.Response:
-        """Fire at `first`; if no answer within hedge_after_s, also fire at a
-        second replica and take whichever succeeds first (the loser is
-        cancelled). An error from every in-flight attempt propagates so
-        request()'s replay logic treats it like an unhedged failure."""
-        primary = asyncio.create_task(self._attempt(first, path, payload, headers))
-        done, _ = await asyncio.wait({primary}, timeout=self.hedge_after_s)
+        """Fire at `first`; if no answer within the trigger, spend one unit
+        of hedge budget and also fire at a second replica, taking whichever
+        succeeds first. The loser is CANCELLED — its HTTP request torn down
+        and awaited, no failure recorded against its replica (a cancelled
+        hedge is the hedge's doing, not the replica's), though the loser's
+        elapsed time feeds its latency EWMA so chronic losers converge to
+        gray. An exhausted budget degrades to un-hedged waiting. An error
+        from every in-flight attempt propagates so request()'s replay logic
+        treats it like an unhedged failure."""
+        t0 = time.monotonic()
+        primary = asyncio.create_task(
+            self._attempt(first, path, payload, headers, validator)
+        )
+        done, _ = await asyncio.wait({primary}, timeout=trigger_s)
         if done:
             return primary.result()  # success or raise-through to replay
         backup_replica = self._pick(tried | {first.url}, prefer)
         if backup_replica is None:  # nowhere to hedge: wait the primary out
             return await primary
+        if not self.hedge_budget.try_spend():
+            # budget refused: fall back to un-hedged (never an error) — the
+            # counter rides self.hedge_budget.exhausted_total
+            return await primary
         self.hedges_total += 1
         backup = asyncio.create_task(
-            self._attempt(backup_replica, path, payload, headers)
+            self._attempt(backup_replica, path, payload, headers, validator)
         )
         pending = {primary, backup}
         last_exc: Optional[BaseException] = None
@@ -501,8 +907,26 @@ class ReplicaPool:
             )
             for t in done:
                 if t.exception() is None:
-                    for p in pending:
-                        p.cancel()
+                    if pending:
+                        for p in pending:
+                            p.cancel()
+                        # actually tear the losing request down (the
+                        # cancelled task closes its HTTP stream) before
+                        # returning — a hedge must not leak work
+                        await asyncio.gather(
+                            *pending, return_exceptions=True
+                        )
+                        self.hedge_cancels_total += len(pending)
+                        if t is backup:
+                            # the loser ran at least this long: a truthful
+                            # lower-bound latency sample for its EWMA (kept
+                            # out of the hedge-trigger window — it is not a
+                            # completed request latency)
+                            self._observe_latency(
+                                first,
+                                (time.monotonic() - t0) * 1e3,
+                                window=False,
+                            )
                     if t is backup:
                         self.hedge_wins_total += 1
                         self._record_success(backup_replica)
@@ -522,16 +946,37 @@ class ReplicaPool:
 
     def snapshot(self) -> dict:
         now = time.monotonic()
+        trigger_s = self._hedge_trigger_s()
         return {
             "pool_requests_total": self.requests_total,
             "pool_replays_total": self.replays_total,
             "pool_hedges_total": self.hedges_total,
             "pool_hedge_wins_total": self.hedge_wins_total,
+            "pool_hedge_cancels_total": self.hedge_cancels_total,
+            "pool_hedge_budget_exhausted_total": self.hedge_budget.exhausted_total,
             "pool_ejections_total": self.ejections_total,
+            "pool_soft_ejections_total": self.soft_ejections_total,
+            "pool_soft_restores_total": self.soft_restores_total,
+            "pool_invalid_responses_total": self.invalid_responses_total,
             "pool_failures_total": self.failures_total,
             "pool_suspended_total": self.suspended_total,
             "pool_retry_budget_exhausted_total": self.retry_budget.exhausted_total,
             "retry_budget": self.retry_budget.snapshot(),
+            "hedge": {
+                "adaptive": self.adaptive_hedge,
+                "trigger_ms": (
+                    round(trigger_s * 1e3, 3) if trigger_s is not None else None
+                ),
+                "quantile": self.hedge_quantile,
+                "budget": self.hedge_budget.snapshot(),
+            },
+            "outlier": {
+                "ratio": self.outlier_ratio,
+                "restore_ratio": self.outlier_restore_ratio,
+                "weight": self.outlier_weight,
+                "min_samples": self.outlier_min_samples,
+                "min_ms": self.outlier_min_ms,
+            },
             "replicas": [
                 {
                     "url": r.url,
@@ -542,6 +987,12 @@ class ReplicaPool:
                     "requests": r.requests,
                     "failures": r.failures,
                     "ejections": r.ejections,
+                    "outlier_state": r.outlier_state,
+                    "outlier_score": round(r.outlier_score, 3),
+                    "weight": self._weight(r),
+                    "req_ewma_ms": round(r.req_ewma.value, 3),
+                    "probe_ewma_ms": round(r.probe_ewma.value, 3),
+                    "soft_ejections": r.soft_ejections,
                     "last_error": r.last_error,
                 }
                 for r in self.replicas

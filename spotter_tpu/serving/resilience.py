@@ -97,6 +97,35 @@ def jittered_retry_after(
     )
 
 
+class Ewma:
+    """Exponentially-weighted moving average with a sample count — the
+    gray-failure outlier score's smoothing primitive (ISSUE 14). Shared
+    vocabulary here (like Deadline/CircuitBreaker) rather than buried in
+    the replica pool: one replica's request latency and its health-probe
+    latency are tracked by two instances with the same semantics, and the
+    sample count is what gates "enough evidence to call this replica an
+    outlier" (a single slow response must not soft-eject anyone)."""
+
+    __slots__ = ("alpha", "value", "samples")
+
+    def __init__(self, alpha: float = 0.3) -> None:
+        self.alpha = min(max(float(alpha), 0.0), 1.0)
+        self.value = 0.0
+        self.samples = 0
+
+    def update(self, x: float) -> float:
+        self.samples += 1
+        if self.samples == 1:
+            self.value = float(x)
+        else:
+            self.value += self.alpha * (float(x) - self.value)
+        return self.value
+
+    def reset(self) -> None:
+        self.value = 0.0
+        self.samples = 0
+
+
 class DeadlineExceededError(TimeoutError):
     """The request's time budget ran out (fetch, queue wait, or device call)."""
 

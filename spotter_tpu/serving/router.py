@@ -121,6 +121,20 @@ class _BadGateway(RuntimeError):
     malformed frame): surfaced to the client as 502."""
 
 
+def frame_response_validator(resp) -> None:
+    """ReplicaPool `validator` (ISSUE 14): full structural + checksum
+    verification of every frame-typed 200 body INSIDE the replay loop, so
+    a corrupt frame is treated exactly like a transport failure of the
+    replica that produced it — counted, ejection-relevant, replayed
+    against the next ranked holder — and never reaches a client. JSON
+    bodies pass through untouched (the frame is the only hop encoding
+    with checksums)."""
+    if resp.headers.get("content-type", "").startswith(
+        wire.FRAME_CONTENT_TYPE
+    ):
+        wire.verify_frame(resp.content)
+
+
 def make_router_app(
     pool: ReplicaPool,
     limiter: AdaptiveLimiter | None = None,
@@ -153,6 +167,12 @@ def make_router_app(
     )
     if aggregator is None:
         aggregator = FleetAggregator(lambda: [r.url for r in pool.replicas])
+    # wire-integrity validation (ISSUE 14): every frame-typed sub-response
+    # is structurally + checksum verified INSIDE the pool's replay loop, so
+    # a corrupt frame is replayed like a transport failure instead of
+    # reaching a client. SPOTTER_TPU_WIRE_CRC=0 disables end to end (the
+    # replicas then emit checksum-less v1 frames there is nothing to check).
+    pool_validator = frame_response_validator if wire.crc_enabled() else None
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["pool"] = pool
     app["edge_limiter"] = limiter
@@ -236,6 +256,9 @@ def make_router_app(
         x_cache = resp.headers.get(wire.X_CACHE_HEADER)
         if x_cache:
             out.headers[wire.X_CACHE_HEADER] = x_cache
+        rid = resp.headers.get(wire.REPLICA_HEADER)
+        if rid:  # replica identity rides through the edge (ISSUE 14)
+            out.headers[wire.REPLICA_HEADER] = rid
         _record_response(len(resp.content), is_frame)
         return out
 
@@ -284,6 +307,7 @@ def make_router_app(
 
         downstream: list = []
         degraded: set[str] = set()
+        replica_ids: list[str] = []
         if groups:
             aff_stats["routed_total"] += len(groups)
 
@@ -298,6 +322,7 @@ def make_router_app(
                     sub_payload,
                     headers=headers,
                     prefer=prefer[owner] or None,
+                    validator=pool_validator,
                 )
 
             gathered = await asyncio.gather(
@@ -310,6 +335,9 @@ def make_router_app(
             for (owner, idxs), resp in zip(groups.items(), gathered):
                 _absorb_sub(owner, resp)
                 downstream.append(resp.headers)
+                rid = resp.headers.get(wire.REPLICA_HEADER)
+                if rid and rid not in replica_ids:
+                    replica_ids.append(rid)
                 if len(groups) == 1 and not edge_answered:
                     return _passthrough(resp, client_frame), downstream
                 if resp.status_code != 200:
@@ -355,6 +383,11 @@ def make_router_app(
         x_cache = wire.summarize_cache_outcomes(x_cache_vals)
         if x_cache is not None:
             out.headers[wire.X_CACHE_HEADER] = x_cache
+        if replica_ids:
+            # every replica that contributed to the fan-in, comma-joined in
+            # owner order (ISSUE 14): a slow merged response decomposes
+            # back to the member(s) that served it
+            out.headers[wire.REPLICA_HEADER] = ",".join(replica_ids)
         _record_response(len(body), client_frame)
         return out, downstream
 
@@ -415,7 +448,10 @@ def make_router_app(
                     urls, payload, headers, client_frame
                 )
             else:
-                resp = await pool.request("/detect", payload, headers=headers)
+                resp = await pool.request(
+                    "/detect", payload, headers=headers,
+                    validator=pool_validator,
+                )
                 downstream = [resp.headers]
                 _absorb_sub("", resp)
                 out = _passthrough(resp, client_frame)
@@ -472,6 +508,11 @@ def make_router_app(
                 "edge_negative_ttl_s": (
                     negcache.max_ttl_s if negcache is not None else 0.0
                 ),
+                # gray-failure immune plane config (ISSUE 14): auditable
+                # per edge like the affinity/wire flags
+                "adaptive_hedge": pool.adaptive_hedge,
+                "outlier_ratio": pool.outlier_ratio,
+                "wire_crc": wire.crc_enabled(),
                 # edge error-budget state (ISSUE 10): same block shape as
                 # the replica's /healthz slo_burn
                 "slo_burn": slo_burn.block(),
@@ -560,9 +601,11 @@ def main() -> None:
     )
     parser.add_argument(
         "--hedge-ms",
-        type=float,
-        default=float(os.environ.get(HEDGE_ENV, "0") or "0"),
-        help="hedge a second replica after this many ms (0 = off)",
+        default=os.environ.get(HEDGE_ENV, "0") or "0",
+        help="hedge a second replica after this many ms (0 = off), or "
+        "'auto' for the adaptive trigger (ISSUE 14): hedge at the live "
+        "pool p95, spend capped by the SPOTTER_TPU_HEDGE_BUDGET_PCT "
+        "sliding-window budget",
     )
     parser.add_argument(
         "--no-affinity",
@@ -591,9 +634,19 @@ def main() -> None:
             port=args.port,
         )
         return
+    hedge_raw = str(args.hedge_ms).strip().lower()
+    adaptive_hedge = hedge_raw == "auto"
+    try:
+        hedge_ms = 0.0 if adaptive_hedge else float(hedge_raw or "0")
+    except ValueError:
+        raise SystemExit(
+            f"--hedge-ms must be a number of milliseconds or 'auto', "
+            f"got {args.hedge_ms!r}"
+        )
     pool = ReplicaPool(
         endpoints,
-        hedge_after_s=args.hedge_ms / 1000.0 if args.hedge_ms > 0 else None,
+        hedge_after_s=hedge_ms / 1000.0 if hedge_ms > 0 else None,
+        adaptive_hedge=adaptive_hedge,
     )
     web.run_app(
         make_router_app(pool, limiter=edge_limiter_from_env()),

@@ -232,6 +232,14 @@ def make_app(
         trace, request_id = obs_http.begin_http_trace(request)
 
         def done(resp: web.Response) -> web.Response:
+            # replica identity header (ISSUE 14 satellite): every /detect
+            # outcome — sheds and errors included — names the replica that
+            # produced it, so a slow or corrupt response joins /debug/fleet
+            # rows and stitched traces by replica id
+            if det is not None:
+                resp.headers[wire.REPLICA_HEADER] = (
+                    det.engine.metrics.replica_id
+                )
             return obs_http.finish_http_trace(
                 trace, request_id, resp, server_timing=True
             )
@@ -239,6 +247,17 @@ def make_app(
         det = request.app["detector"]
         if det is None:  # still loading/warming: shed, probe /startupz
             return done(_not_ready_response(tracker))
+        if faults.take_flaky():
+            # injected intermittent failure (ISSUE 14 chaos matrix): the
+            # gray-failure shape hard ejection can't see — a 500 rate below
+            # the consecutive-failure threshold. 500 is a REPLAYABLE status
+            # at the pool, so the edge masks each one
+            return done(
+                web.json_response(
+                    {"error": "injected flaky failure", "status": 500},
+                    status=500,
+                )
+            )
         shed = det.check_admission()
         if shed is not None:  # draining / breaker open: reject before parsing
             return done(_shed_response(shed))
@@ -281,8 +300,12 @@ def make_app(
         # this response — schemas.py contract).
         frame = wire.wants_frame(request.headers.get("Accept"))
         if frame:
+            # corrupt_frame injection (ISSUE 14): while armed, one byte of
+            # the encoded frame is flipped AFTER the checksums were
+            # computed — the deterministic way to prove the edge CRC
+            # validator catches, counts, and replays corruption
             resp = web.Response(
-                body=wire.encode_frame(body),
+                body=faults.corrupt_frame_bytes(wire.encode_frame(body)),
                 content_type=wire.FRAME_CONTENT_TYPE,
             )
         else:

@@ -15,17 +15,33 @@ and the header itself deflate-compressed (the detection dicts and
 description are highly compressible JSON; raw JPEG is not, so ONLY the
 header is compressed).
 
-Frame layout (all integers big-endian):
+Frame layout (all integers big-endian). Version 2 (ISSUE 14) adds wire
+integrity: a checksum of the (possibly deflated) header bytes and one per
+segment, so a flipped bit anywhere in the payload is a typed
+`FrameCorruptError` — counted, replayed against the next ranked holder at
+the edge, never a silent garbage decode or a client-visible 500. Version 1
+frames (no checksums) still parse; `SPOTTER_TPU_WIRE_CRC=0` makes the
+encoder emit v1 for interop with pre-checksum peers.
 
     offset  size  field
     0       4     magic "SPTF"
-    4       1     version (1)
-    5       1     flags (bit 0: header is zlib-deflated)
+    4       1     version (2; decoder also accepts 1)
+    5       1     flags (bit 0: header is deflated; bit 1: preset dict)
     6       2     reserved (0)
     8       4     segment count N
     12      4     header length H
-    16      H     header JSON (per flags, possibly deflated)
-    16+H    ...   N segments, each: u32 length + raw bytes
+    16      4     header checksum (v2 only; CRC over the H header bytes)
+    20      H     header JSON (per flags, possibly deflated)
+    20+H    ...   N segments, each: u32 length + u32 checksum + raw bytes
+                  (v1 segments carry no checksum)
+
+The checksum is `zlib.crc32` (CRC-32/ISO-HDLC). CRC32C (Castagnoli) would
+be the textbook pick for storage/wire integrity, but CPython ships no
+C-speed Castagnoli and a pure-Python table walk costs ~milliseconds per
+JPEG segment — a wire-integrity layer must be effectively free, and
+zlib's C CRC-32 detects the same burst/bit-flip corruption class at
+GB/s. The polynomial is part of the wire contract: changing it is a
+version bump.
 
 The header JSON is exactly the `DetectionResponse.model_dump(
 exclude_none=True)` dict, except each success image carries
@@ -57,6 +73,7 @@ Stdlib-only and jax-free: the router process imports this.
 
 import base64
 import json
+import os
 import struct
 import time
 import zlib
@@ -66,11 +83,26 @@ from spotter_tpu.caching.keys import normalize_url
 
 FRAME_CONTENT_TYPE = "application/x-spotter-frame"
 FRAME_MAGIC = b"SPTF"
-FRAME_VERSION = 1
+FRAME_VERSION = 2  # v2: header + per-segment checksums (ISSUE 14)
+FRAME_VERSION_V1 = 1  # still parsed; emitted when SPOTTER_TPU_WIRE_CRC=0
 _FLAG_DEFLATED = 0x01  # header is zlib-compressed
 _FLAG_DICT = 0x02  # header is RAW deflate against the preset dictionary
 _HEAD = struct.Struct(">4sBBHII")  # magic, version, flags, reserved, nseg, hlen
 _U32 = struct.Struct(">I")
+
+WIRE_CRC_ENV = "SPOTTER_TPU_WIRE_CRC"
+
+
+def _crc(data: bytes) -> int:
+    """The frame checksum (see the module docstring for why CRC-32 over
+    CRC32C here): zlib's C implementation, masked to u32."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def crc_enabled() -> bool:
+    """Frame checksums are the default; SPOTTER_TPU_WIRE_CRC=0 emits
+    checksum-less v1 frames (decoding always accepts both versions)."""
+    return os.environ.get(WIRE_CRC_ENV, "1").strip() not in ("", "0")
 
 # Preset deflate dictionary (the SPDY header-dict trick): the response
 # vocabulary is fixed protocol-side, so seeding the compressor with it
@@ -101,6 +133,12 @@ FRAME_ZDICT = json.dumps(
 
 X_CACHE_HEADER = "X-Cache"
 NEGATIVE_HEADER = "X-Spotter-Negative"
+# Which replica produced this response (ISSUE 14 satellite): the ISSUE 12
+# identity stamp (`replica_id` from /metrics) echoed as a header at the
+# replica AND forwarded by the edge, so any slow or corrupt response joins
+# /debug/fleet rows and stitched traces by replica id without scraping.
+# Fan-in responses carry every contributing replica, comma-joined.
+REPLICA_HEADER = "X-Spotter-Replica"
 
 # cap the per-verdict error text: headers are not a payload channel
 _MAX_ERROR_CHARS = 200
@@ -112,6 +150,14 @@ MAX_EDGE_NEGATIVE_ENTRIES = 4096
 
 class FrameError(ValueError):
     """Malformed frame (bad magic/version, truncated segment, bad index)."""
+
+
+class FrameCorruptError(FrameError):
+    """A frame whose bytes fail their checksum (header or segment): the
+    payload was damaged in transit or at rest. Distinct from FrameError so
+    the edge can count corruption separately and treat it as a transport
+    failure of the replica that produced it (replay on the next ranked
+    holder) rather than a protocol bug."""
 
 
 def wants_frame(accept: str | None) -> bool:
@@ -170,41 +216,72 @@ def restore_segments(header: dict, segments: list[bytes]) -> dict:
     return body
 
 
-def build_frame(header: dict, segments: list[bytes]) -> bytes:
+def build_frame(
+    header: dict, segments: list[bytes], crc: bool | None = None
+) -> bytes:
     """Serialize an already-split (header, segments) pair. The header is
     deflated when that actually shrinks it (it always does for real
-    responses; tiny test fixtures may not)."""
+    responses; tiny test fixtures may not). `crc` (default
+    `SPOTTER_TPU_WIRE_CRC`) selects the v2 checksummed layout; False emits
+    a checksum-less v1 frame for pre-checksum peers."""
+    if crc is None:
+        crc = crc_enabled()
     raw = json.dumps(header, separators=(",", ":")).encode("utf-8")
     co = zlib.compressobj(9, zlib.DEFLATED, -15, zdict=FRAME_ZDICT)
     deflated = co.compress(raw) + co.flush()
     flags = 0
     if len(deflated) < len(raw):
         raw, flags = deflated, _FLAG_DEFLATED | _FLAG_DICT
-    parts = [
-        _HEAD.pack(FRAME_MAGIC, FRAME_VERSION, flags, 0, len(segments), len(raw)),
-        raw,
-    ]
+    version = FRAME_VERSION if crc else FRAME_VERSION_V1
+    head = _HEAD.pack(FRAME_MAGIC, version, flags, 0, len(segments), len(raw))
+    parts = [head]
+    if crc:
+        # the header checksum covers the fixed preamble too, so a flipped
+        # bit in flags/reserved/counts is caught even where the structure
+        # would still parse
+        parts.append(_U32.pack(_crc(head + raw)))
+    parts.append(raw)
     for seg in segments:
         parts.append(_U32.pack(len(seg)))
+        if crc:
+            parts.append(_U32.pack(_crc(seg)))
         parts.append(seg)
     return b"".join(parts)
 
 
 def split_frame(data: bytes) -> tuple[dict, list[bytes]]:
     """Parse a frame into (header, segments) without touching base64 — the
-    router's merge path re-frames segments as-is."""
+    router's merge path re-frames segments as-is. Raises FrameError on any
+    structural damage (truncation, bad magic/version/JSON) and
+    FrameCorruptError when a v2 checksum does not match its bytes — never
+    struct.error/KeyError/UnicodeDecodeError, and never a garbage decode
+    (the fuzz contract, tests/test_wire.py)."""
     if len(data) < _HEAD.size:
         raise FrameError(f"frame truncated at {len(data)} bytes")
     magic, version, flags, _, nseg, hlen = _HEAD.unpack_from(data, 0)
     if magic != FRAME_MAGIC:
         raise FrameError(f"bad frame magic {magic!r}")
-    if version != FRAME_VERSION:
+    if version not in (FRAME_VERSION_V1, FRAME_VERSION):
         raise FrameError(f"unsupported frame version {version}")
+    checked = version >= FRAME_VERSION
     off = _HEAD.size
-    if len(data) < off + hlen:
+    header_crc = None
+    if checked:
+        if len(data) < off + _U32.size:
+            raise FrameError("frame header checksum truncated")
+        (header_crc,) = _U32.unpack_from(data, off)
+        off += _U32.size
+    if hlen > len(data) - off:
         raise FrameError("frame header truncated")
     raw = data[off:off + hlen]
     off += hlen
+    if header_crc is not None:
+        got = _crc(data[: _HEAD.size] + raw)
+        if got != header_crc:
+            raise FrameCorruptError(
+                f"frame header checksum mismatch "
+                f"(expected {header_crc:#010x}, got {got:#010x})"
+            )
     if flags & _FLAG_DEFLATED:
         try:
             if flags & _FLAG_DICT:
@@ -216,7 +293,7 @@ def split_frame(data: bytes) -> tuple[dict, list[bytes]]:
             raise FrameError(f"bad deflated header: {exc}") from None
     try:
         header = json.loads(raw)
-    except json.JSONDecodeError as exc:
+    except (json.JSONDecodeError, UnicodeDecodeError) as exc:
         raise FrameError(f"bad header JSON: {exc}") from None
     if not isinstance(header, dict):
         raise FrameError("frame header is not an object")
@@ -226,11 +303,31 @@ def split_frame(data: bytes) -> tuple[dict, list[bytes]]:
             raise FrameError("frame segment table truncated")
         (seg_len,) = _U32.unpack_from(data, off)
         off += _U32.size
-        if len(data) < off + seg_len:
+        seg_crc = None
+        if checked:
+            if len(data) < off + _U32.size:
+                raise FrameError("frame segment checksum truncated")
+            (seg_crc,) = _U32.unpack_from(data, off)
+            off += _U32.size
+        if seg_len > len(data) - off:
             raise FrameError("frame segment truncated")
-        segments.append(data[off:off + seg_len])
+        seg = data[off:off + seg_len]
         off += seg_len
+        if seg_crc is not None and _crc(seg) != seg_crc:
+            raise FrameCorruptError(
+                f"frame segment {len(segments)} checksum mismatch "
+                f"(expected {seg_crc:#010x}, got {_crc(seg):#010x})"
+            )
+        segments.append(seg)
     return header, segments
+
+
+def verify_frame(data: bytes) -> None:
+    """Full structural + checksum validation of a frame, result discarded:
+    the replica-pool `validator` hook's body (the router passes this over
+    frame-typed sub-responses so a corrupt frame is replayed like a
+    transport failure, ISSUE 14)."""
+    split_frame(data)
 
 
 def encode_frame(body: dict) -> bytes:

@@ -1,0 +1,264 @@
+"""Deterministic gray-failure chaos matrix (ISSUE 14).
+
+The fleet chaos coverage grew scenario by scenario (kill a replica, storm
+the spot pool, poison a batch...), each hand-rolled in its own test. This
+module is the scenario RUNNER for the gray-failure class: a `Scenario` is
+a named fault shape (whole-replica slowdown, deterministic flaky 500s,
+corrupt binary frames — the faults.py ISSUE 14 injections) plus a workload
+and a set of invariants, executed over a model-free in-process topology:
+N stub replicas (the REAL standalone `make_app` over stub detectors)
+behind the REAL `ReplicaPool` + edge router, adaptive hedging and outlier
+scoring armed. Everything is deterministic by construction — Bresenham
+fault thinning, counter-armed corruptions, a fixed URL cycle — so a
+scenario's invariants are exact assertions, not flaky thresholds.
+
+`GRAY_MATRIX` is the default matrix; `tests/test_grayfail.py` runs every
+row, and `bench.py --gray-storm` is the measured (timed, gated) sibling of
+the `gray-slow` row. Scenarios are cheap (~a second each): the point is
+that adding a new gray-failure shape is one dataclass literal, not a new
+harness.
+"""
+
+import asyncio
+from dataclasses import dataclass, field
+
+from spotter_tpu.testing import faults
+
+# fixed URL cycle: distinct keys so affinity routing spreads ownership,
+# repeated so per-URL behavior is exercised more than once
+URL_CYCLE = [f"http://chaos.example.com/img-{i}.jpg" for i in range(16)]
+
+
+@dataclass
+class Scenario:
+    """One deterministic gray-failure scenario.
+
+    `gray` / `gray_factor`: mid-load, multiply replica `gray`'s stub
+    service time by the factor (the in-process form of the
+    `slow_replica=<ms>` injection — per-replica by construction, since
+    each stub engine is its own object). `faults`: a faults.inject(...)
+    plan active for the whole load (flaky=<pct>, corrupt_frame=<n>, ...).
+    `frame`: clients negotiate the binary frame, so the edge CRC validator
+    is on the response path. `invariants`: exact checks over the final
+    report — every key must hold or the scenario fails.
+    """
+
+    name: str
+    requests: int = 90
+    concurrency: int = 4
+    replicas: int = 3
+    service_ms: float = 5.0
+    gray: int | None = None
+    gray_factor: float = 20.0
+    gray_at: float = 0.3  # fraction of the load after which `gray` slows
+    faults: dict = field(default_factory=dict)
+    frame: bool = False
+    invariants: dict = field(default_factory=dict)
+
+
+GRAY_MATRIX = [
+    Scenario(
+        name="baseline",
+        invariants={
+            "client_failures": 0,
+            "soft_ejections": 0,
+            "invalid_responses": 0,
+        },
+    ),
+    Scenario(
+        name="gray-slow",
+        gray=0,
+        requests=140,
+        invariants={
+            "client_failures": 0,
+            "gray_detected": True,
+            # the gray replica's share of the post-detection load must
+            # collapse toward the outlier weight (5%); 30% is the loose
+            # exact-free bound that still proves the weight-down works
+            "gray_tail_share_lt": 0.30,
+        },
+    ),
+    Scenario(
+        name="flaky",
+        # 5%, deliberately UNDER the 10% retry budget: every injected 500
+        # is masked by a budgeted replay. (A flaky rate past the budget is
+        # a different, correct outcome — fast 503s instead of retry
+        # amplification — covered by test_replica_pool's budget tests.)
+        faults={"flaky": 5},
+        requests=100,
+        invariants={
+            "client_failures": 0,  # every injected 500 masked by replay
+            "replays_gt": 0,
+        },
+    ),
+    Scenario(
+        name="corrupt-frames",
+        faults={"corrupt_frame": 3},
+        frame=True,
+        invariants={
+            "client_failures": 0,  # every corrupt frame replayed, not 502'd
+            "invalid_responses": 3,
+        },
+    ),
+    Scenario(
+        name="gray-plus-corrupt",
+        gray=1,
+        requests=140,
+        faults={"corrupt_frame": 2},
+        frame=True,
+        invariants={
+            "client_failures": 0,
+            "gray_detected": True,
+            "invalid_responses": 2,
+        },
+    ),
+]
+
+
+async def run_scenario(sc: Scenario) -> dict:
+    """Execute one scenario; returns the report dict (see `evaluate`)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving import wire
+    from spotter_tpu.serving.detector import AmenitiesDetector
+    from spotter_tpu.serving.replica_pool import ReplicaPool
+    from spotter_tpu.serving.router import make_router_app
+    from spotter_tpu.serving.standalone import make_app
+    from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+    engines, dets, servers, urls = [], [], [], []
+    for i in range(sc.replicas):
+        engine = StubEngine(service_ms=sc.service_ms)
+        engine.metrics.set_identity(replica_id=f"chaos-r{i}")
+        det = AmenitiesDetector(
+            engine, MicroBatcher(engine, max_delay_ms=1.0), StubHttpClient()
+        )
+        server = TestServer(make_app(detector=det))
+        await server.start_server()
+        engines.append(engine)
+        dets.append(det)
+        servers.append(server)
+        urls.append(f"http://{server.host}:{server.port}")
+
+    pool = ReplicaPool(
+        urls,
+        health_interval_s=0.05,
+        adaptive_hedge=True,
+        # fast, test-friendly outlier knobs: same machinery, smaller
+        # evidence requirements so a ~1 s scenario converges
+        outlier_min_samples=5,
+        outlier_min_ms=5.0,
+        outlier_alpha=0.4,
+    )
+    aggregator = FleetAggregator(lambda: [], interval_s=0.0)  # determinism
+    router_app = make_router_app(pool, aggregator=aggregator)
+
+    gray_after = int(sc.requests * sc.gray_at)
+    tail_from = int(sc.requests * 0.7)
+    counts_at_tail: list[int] = []
+    client_failures = 0
+    statuses: dict[int, int] = {}
+    headers = (
+        {"Accept": wire.FRAME_CONTENT_TYPE} if sc.frame else {}
+    )
+
+    async with TestClient(TestServer(router_app)) as client:
+        cursor = {"i": 0}
+
+        async def worker() -> None:
+            nonlocal client_failures
+            while cursor["i"] < sc.requests:
+                i = cursor["i"]
+                cursor["i"] += 1
+                if sc.gray is not None and i == gray_after:
+                    engines[sc.gray].service_s *= sc.gray_factor
+                if i == tail_from:
+                    counts_at_tail.extend(
+                        r.requests for r in pool.replicas
+                    )
+                resp = await client.post(
+                    "/detect",
+                    json={"image_urls": [URL_CYCLE[i % len(URL_CYCLE)]]},
+                    headers=headers,
+                )
+                await resp.read()
+                statuses[resp.status] = statuses.get(resp.status, 0) + 1
+                if resp.status != 200:
+                    client_failures += 1
+
+        with faults.inject(**sc.faults):
+            await asyncio.gather(*(worker() for _ in range(sc.concurrency)))
+
+        snap = pool.snapshot()
+
+    for server in servers:
+        await server.close()
+    for det in dets:
+        await det.aclose()
+
+    tail_requests = [
+        r["requests"] - (counts_at_tail[j] if j < len(counts_at_tail) else 0)
+        for j, r in enumerate(snap["replicas"])
+    ]
+    tail_total = sum(tail_requests) or 1
+    gray_idx = sc.gray if sc.gray is not None else -1
+    report = {
+        "name": sc.name,
+        "statuses": statuses,
+        "client_failures": client_failures,
+        "replays": snap["pool_replays_total"],
+        "hedges": snap["pool_hedges_total"],
+        "soft_ejections": snap["pool_soft_ejections_total"],
+        "invalid_responses": snap["pool_invalid_responses_total"],
+        "gray_state": (
+            snap["replicas"][gray_idx]["outlier_state"]
+            if 0 <= gray_idx < len(snap["replicas"])
+            else None
+        ),
+        "gray_tail_share": (
+            tail_requests[gray_idx] / tail_total
+            if 0 <= gray_idx < len(tail_requests)
+            else 0.0
+        ),
+        "replica_snapshots": snap["replicas"],
+    }
+    report["checks"] = evaluate(sc, report)
+    report["ok"] = all(report["checks"].values())
+    return report
+
+
+def evaluate(sc: Scenario, report: dict) -> dict:
+    """Invariant name -> bool for every invariant the scenario declares."""
+    checks: dict[str, bool] = {}
+    for key, want in sc.invariants.items():
+        if key == "client_failures":
+            checks[key] = report["client_failures"] == want
+        elif key == "soft_ejections":
+            checks[key] = report["soft_ejections"] == want
+        elif key == "invalid_responses":
+            checks[key] = report["invalid_responses"] == want
+        elif key == "replays_gt":
+            checks[key] = report["replays"] > want
+        elif key == "gray_detected":
+            # gray OR already recovering through canary counts as detected
+            checks[key] = (
+                report["gray_state"] in ("gray", "canary")
+                and report["soft_ejections"] >= 1
+            ) == want
+        elif key == "gray_tail_share_lt":
+            checks[key] = report["gray_tail_share"] < want
+        else:
+            raise ValueError(f"unknown invariant {key!r} in {sc.name}")
+    return checks
+
+
+def run_matrix(scenarios: list[Scenario] | None = None) -> list[dict]:
+    """Run every scenario (fresh event loop each — total isolation);
+    returns the reports. Callers assert `all(r["ok"] for r in reports)`
+    and print the failing report for diagnosis."""
+    reports = []
+    for sc in scenarios if scenarios is not None else GRAY_MATRIX:
+        reports.append(asyncio.run(run_scenario(sc)))
+    return reports

@@ -61,6 +61,24 @@ admission limiter (serving/overload.py) consumes one per CONTROL TICK via
 its target — N ticks of synthetic saturation, enough to cut the AIMD limit
 to its floor and (sustained past the arm window) walk the brownout ladder,
 all without generating real queue pressure.
+
+The gray-failure tier (ISSUE 14) adds the three injections the chaos
+matrix (testing/chaos_matrix.py) and `bench.py --gray-storm` compose:
+
+- `slow_replica=<ms>`: every engine call in THIS process sleeps that long
+  first — a replica that still answers /healthz 200 but serves everything
+  slow, the gray-failure signature the outlier score exists to catch. Per
+  replica by construction: each supervised replica subprocess reads its
+  own SPOTTER_TPU_FAULTS (testing/cluster.py), so exactly the marked
+  member turns gray.
+- `flaky=<pct>`: the replica answers HTTP 500 for that percentage of
+  /detect requests, DETERMINISTICALLY (a Bresenham-style credit counter,
+  not a random draw) — the intermittent-error half of gray failure, below
+  the consecutive-failure threshold hard ejection needs.
+- `corrupt_frame=<n>`: the next N binary-frame response bodies get one
+  byte flipped after encoding (`corrupt_frame_bytes`), so the edge's CRC
+  validator (wire.py v2) must catch each one, count it, and replay on
+  another replica with zero client-visible errors.
 """
 
 import asyncio
@@ -109,9 +127,17 @@ class FaultPlan:
     # deterministically ("the device span grew by exactly the injected
     # amount"). Multiple stages: ";"-separated pairs.
     slow_stage: str = ""
+    # ISSUE 14 gray-failure tier: whole-replica slowdown (ms per engine
+    # call — the gray signature), deterministic intermittent 500s (percent
+    # of /detect requests), and armed frame corruptions (next N binary
+    # frame responses get a byte flipped after encoding)
+    slow_replica: float = 0.0
+    flaky: int = 0
+    corrupt_frame: int = 0
     # set() to un-wedge hanging engine calls early (tests)
     release: threading.Event = field(default_factory=threading.Event)
     _lock: threading.Lock = field(default_factory=threading.Lock)
+    _flaky_credit: int = 0
 
     def _consume(self, attr: str) -> bool:
         with self._lock:
@@ -171,6 +197,9 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "preempt_storm",
             "overload_spike",
             "slow_stage",
+            "slow_replica",
+            "flaky",
+            "corrupt_frame",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
         if key == "slow_stage":
@@ -178,7 +207,10 @@ def maybe_activate_from_env() -> FaultPlan | None:
             _parse_slow_stage(kwargs[key])  # fail loudly at activation
             continue
         try:
-            kwargs[key] = float(value) if key.endswith("_s") else int(value)
+            if key.endswith("_s") or key == "slow_replica":  # durations
+                kwargs[key] = float(value)
+            else:
+                kwargs[key] = int(value)
         except ValueError:
             raise ValueError(f"bad {FAULTS_ENV} entry {part!r}") from None
     _active = FaultPlan(**kwargs)
@@ -334,3 +366,43 @@ def on_shard_probe(device_id: int) -> None:
         raise RuntimeError(
             f"injected shard loss: device {device_id} halted (probe)"
         )
+
+
+# ---- gray-failure tier (ISSUE 14) ----
+
+
+def replica_delay_s() -> float:
+    """Whole-replica slowdown for this process (seconds per engine call);
+    0.0 when no plan is active — the usual single None check. The stub
+    engine sleeps this inside its `device` stage window so the slowdown is
+    visible in traces and stage histograms like a real throttled device."""
+    plan = _active
+    if plan is None or plan.slow_replica <= 0:
+        return 0.0
+    return plan.slow_replica / 1000.0
+
+
+def take_flaky() -> bool:
+    """/detect handler hook: True when THIS request should answer 500.
+    Deterministic Bresenham-style thinning — `flaky=25` fails exactly every
+    4th request, no RNG — so chaos-matrix scenarios assert exact counts."""
+    plan = _active
+    if plan is None or plan.flaky <= 0:
+        return False
+    with plan._lock:
+        plan._flaky_credit += min(plan.flaky, 100)
+        if plan._flaky_credit >= 100:
+            plan._flaky_credit -= 100
+            return True
+    return False
+
+
+def corrupt_frame_bytes(data: bytes) -> bytes:
+    """Response-encode hook: while armed, flip one byte near the tail of
+    the encoded frame (segment bytes — a CRC-protected region) and consume
+    one `corrupt_frame` unit. Identity when not armed."""
+    plan = _active
+    if plan is None or not data or not plan._consume("corrupt_frame"):
+        return data
+    idx = max(len(data) - 2, 0)
+    return data[:idx] + bytes([data[idx] ^ 0xFF]) + data[idx + 1:]

@@ -74,6 +74,13 @@ class StubEngine:
         faults.sleep_stage(obs.H2D)
         t_h2d = time.monotonic()
         faults.sleep_stage(obs.DEVICE)
+        # gray-failure injection (ISSUE 14): a slow_replica plan makes THIS
+        # process's every engine call slower inside the device window —
+        # /healthz stays green while /detect latency grows, the signature
+        # the pool's outlier score must catch
+        delay_s = faults.replica_delay_s()
+        if delay_s > 0:
+            time.sleep(delay_s)
         if self.service_s > 0:
             time.sleep(self.service_s)
         t_dev = time.monotonic()

@@ -1,0 +1,448 @@
+"""Gray-failure immunity (ISSUE 14): latency outlier scoring + soft
+ejection, budgeted adaptive hedging (loser cancellation, budget
+exhaustion, affinity composition), the X-Spotter-Replica identity header,
+and the deterministic chaos matrix. Replicas are tiny in-process aiohttp
+servers (the test_replica_pool pattern) or full stub-detector apps (the
+chaos matrix) — model-free, CPU-safe."""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from spotter_tpu.serving.replica_pool import (
+    CANARY_OK_REQUIRED,
+    OUTLIER_CANARY,
+    OUTLIER_GRAY,
+    OUTLIER_OK,
+    ReplicaPool,
+    RetryBudget,
+)
+from spotter_tpu.serving.resilience import Ewma
+
+PAYLOAD = {"image_urls": ["http://example.com/room.jpg"]}
+
+
+class ScriptedReplica:
+    """In-process /detect + /healthz server: scriptable latency for both
+    routes, cancellation tracking on /detect (the hedge-loser contract)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.status = 200
+        self.delay_s = 0.0
+        self.health_delay_s = 0.0
+        self.health_status = 200
+        self.detect_calls = 0
+        self.cancelled = 0
+        app = web.Application()
+        app.router.add_post("/detect", self._detect)
+        app.router.add_get("/healthz", self._healthz)
+        self.server = TestServer(app)
+
+    async def _detect(self, request: web.Request) -> web.Response:
+        self.detect_calls += 1
+        try:
+            if self.delay_s:
+                await asyncio.sleep(self.delay_s)
+        except asyncio.CancelledError:
+            # the hedge loser's socket was torn down mid-service: the
+            # aiohttp handler task is cancelled when the client disconnects
+            self.cancelled += 1
+            raise
+        return web.json_response({"served_by": self.name}, status=self.status)
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        if self.health_delay_s:
+            await asyncio.sleep(self.health_delay_s)
+        return web.json_response({}, status=self.health_status)
+
+    async def start(self) -> str:
+        await self.server.start_server()
+        return f"http://{self.server.host}:{self.server.port}"
+
+    async def stop(self) -> None:
+        await self.server.close()
+
+
+async def _with_replicas(n):
+    replicas = [ScriptedReplica(f"r{i}") for i in range(n)]
+    urls = [await r.start() for r in replicas]
+    return replicas, urls
+
+
+# ---- outlier scoring units -------------------------------------------------
+
+
+def test_ewma_warmup_and_smoothing():
+    e = Ewma(alpha=0.5)
+    assert e.samples == 0 and e.value == 0.0
+    assert e.update(100.0) == 100.0  # first sample seeds, no smoothing
+    assert e.update(0.0) == 50.0
+    assert e.samples == 2
+    e.reset()
+    assert e.samples == 0 and e.value == 0.0
+
+
+def _quiet_pool(urls=None, **kwargs) -> ReplicaPool:
+    """A pool that never talks to the network (health loop not started)."""
+    kwargs.setdefault("health_interval_s", 30.0)
+    return ReplicaPool(urls or ["http://10.0.0.1:1", "http://10.0.0.2:1",
+                                "http://10.0.0.3:1"], **kwargs)
+
+
+def test_outlier_trips_gray_then_canary_then_restores():
+    pool = _quiet_pool(outlier_min_samples=4, outlier_min_ms=5.0)
+    r0, r1, r2 = pool.replicas
+    for _ in range(6):
+        for r in (r1, r2):
+            pool._observe_latency(r, 10.0)
+        pool._observe_latency(r0, 10.0)
+    assert all(r.outlier_state == OUTLIER_OK for r in pool.replicas)
+    # r0 turns 10x slow: EWMA crosses ratio x median -> soft-ejected
+    for _ in range(8):
+        pool._observe_latency(r0, 100.0)
+    assert r0.outlier_state == OUTLIER_GRAY
+    assert r0.outlier_score > pool.outlier_ratio
+    assert pool.soft_ejections_total == 1
+    assert pool._weight(r0) == pool.outlier_weight
+    # recovery: fast samples decay the EWMA under the restore ratio ->
+    # canary re-probe at quarter weight, NOT an instant full restore
+    while r0.outlier_state == OUTLIER_GRAY:
+        pool._observe_latency(r0, 10.0)
+    assert r0.outlier_state == OUTLIER_CANARY
+    assert pool._weight(r0) == 0.25
+    # the canary needs CANARY_OK_REQUIRED good responses to fully restore
+    for _ in range(CANARY_OK_REQUIRED + 1):
+        pool._observe_latency(r0, 10.0)
+    assert r0.outlier_state == OUTLIER_OK
+    assert pool.soft_restores_total == 1
+    assert pool._weight(r0) == 1.0
+
+
+def test_canary_relapse_goes_back_to_gray():
+    pool = _quiet_pool(outlier_min_samples=4, outlier_min_ms=5.0)
+    r0, r1, r2 = pool.replicas
+    for _ in range(8):
+        pool._observe_latency(r1, 10.0)
+        pool._observe_latency(r2, 10.0)
+        pool._observe_latency(r0, 100.0)
+    assert r0.outlier_state == OUTLIER_GRAY
+    while r0.outlier_state == OUTLIER_GRAY:
+        pool._observe_latency(r0, 10.0)
+    assert r0.outlier_state == OUTLIER_CANARY
+    for _ in range(10):  # canary traffic is slow again -> relapse
+        pool._observe_latency(r0, 200.0)
+    assert r0.outlier_state == OUTLIER_GRAY
+
+
+def test_last_available_replica_is_never_soft_ejected():
+    pool = _quiet_pool(outlier_min_samples=4, outlier_min_ms=5.0)
+    r0, r1, r2 = pool.replicas
+    for _ in range(6):
+        for r in pool.replicas:
+            pool._observe_latency(r, 10.0)
+    r1.healthy = False
+    r2.healthy = False
+    for _ in range(10):
+        pool._observe_latency(r0, 500.0)
+    # r0 is wildly slow but it is all the pool has: thinning it would only
+    # slow the pool further
+    assert r0.outlier_state == OUTLIER_OK
+    assert pool.soft_ejections_total == 0
+
+
+def test_absolute_floor_blocks_microsecond_noise():
+    pool = _quiet_pool(outlier_min_samples=4, outlier_min_ms=20.0)
+    r0, r1, r2 = pool.replicas
+    # 10x relative spread, but everything is far under the floor: a fast
+    # fleet's jitter must not manufacture outliers
+    for _ in range(10):
+        pool._observe_latency(r0, 5.0)
+        pool._observe_latency(r1, 0.5)
+        pool._observe_latency(r2, 0.5)
+    assert r0.outlier_state == OUTLIER_OK
+    assert pool.soft_ejections_total == 0
+
+
+def test_gray_weight_thins_round_robin_selection():
+    pool = _quiet_pool(outlier_min_samples=4)
+    r0 = pool.replicas[0]
+    r0.outlier_state = OUTLIER_GRAY
+    picks = [pool._pick(set()).url for _ in range(300)]
+    share = picks.count(r0.url) / len(picks)
+    # smooth WRR at weight 0.05 vs 1.0+1.0: expected share ~2.4%
+    assert share < 0.10, f"gray replica still got {share:.1%} of picks"
+    # the two healthy replicas split the rest evenly (smooth WRR property)
+    others = [picks.count(r.url) for r in pool.replicas[1:]]
+    assert abs(others[0] - others[1]) <= 2
+
+
+def test_gray_owner_thinned_in_prefer_order():
+    pool = _quiet_pool(outlier_min_samples=4)
+    r0, r1, _ = pool.replicas
+    r0.outlier_state = OUTLIER_GRAY
+    prefer = [r0.url, r1.url]
+    picks = [pool._pick(set(), prefer=prefer).url for _ in range(100)]
+    # deterministic credit thinning: the gray owner keeps EXACTLY its
+    # weight's share of its keyed traffic (the canary trickle), the rest
+    # falls to the next-ranked holder
+    assert picks.count(r0.url) == round(pool.outlier_weight * 100)
+    assert picks.count(r1.url) == 100 - round(pool.outlier_weight * 100)
+
+
+def test_probe_latency_flags_silent_slow_replica_with_zero_traffic():
+    """The ISSUE 14 satellite bugfix: _health_loop used to measure probe
+    latency and throw it away. A replica whose /healthz answers 200 but
+    slow (starved event loop — the gray signature) must go gray from
+    probes alone, before any /detect traffic touches it."""
+
+    async def run():
+        replicas, urls = await _with_replicas(3)
+        replicas[0].health_delay_s = 0.15
+        pool = ReplicaPool(
+            urls,
+            health_interval_s=0.05,
+            outlier_min_samples=3,
+            outlier_min_ms=5.0,
+        )
+        await pool.start()
+        try:
+            for _ in range(100):
+                if pool.replicas[0].outlier_state == OUTLIER_GRAY:
+                    break
+                await asyncio.sleep(0.05)
+            r0 = pool.replicas[0]
+            assert r0.outlier_state == OUTLIER_GRAY, (
+                f"state={r0.outlier_state} score={r0.outlier_score} "
+                f"probe_ewma={r0.probe_ewma.value}"
+            )
+            assert r0.probe_ewma.value > 100.0
+            # zero /detect traffic was needed
+            assert all(r.detect_calls == 0 for r in replicas)
+            # it is still AVAILABLE (healthz 200): soft ejection, not hard
+            assert r0.available(time.monotonic())
+            snap = pool.snapshot()
+            assert snap["pool_soft_ejections_total"] == 1
+            r0_snap = snap["replicas"][0]
+            assert r0_snap["outlier_state"] == OUTLIER_GRAY
+            assert r0_snap["weight"] == pool.outlier_weight
+        finally:
+            await pool.stop()
+            for r in replicas:
+                await r.stop()
+
+    asyncio.run(run())
+
+
+# ---- budgeted adaptive hedging ---------------------------------------------
+
+
+def test_adaptive_trigger_tracks_observed_quantile():
+    pool = _quiet_pool(adaptive_hedge=True)
+    assert pool._hedge_trigger_s() is None  # cold window, no static timer
+    for ms in [10.0] * 95 + [200.0] * 5:
+        pool._lat_window.append(ms)
+    trig = pool._hedge_trigger_s()
+    assert trig is not None
+    # p95 of 95x10ms + 5x200ms sits at the 10/200 boundary
+    assert 0.009 <= trig <= 0.21
+    snap = pool.snapshot()
+    assert snap["hedge"]["adaptive"] is True
+    assert snap["hedge"]["trigger_ms"] == pytest.approx(trig * 1e3)
+
+
+def test_hedge_loser_is_cancelled_and_not_counted_as_failure():
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        replicas[0].delay_s = 1.0  # alive but drowning
+        pool = ReplicaPool(urls, hedge_after_s=0.05, health_interval_s=30.0)
+        body = await pool.detect(PAYLOAD)
+        assert body["served_by"] == "r1"  # the hedge won
+        assert pool.hedges_total == 1
+        assert pool.hedge_wins_total == 1
+        assert pool.hedge_cancels_total == 1
+        # loser exclusion: the cancelled attempt is the hedge's doing, not
+        # the replica's — no failure, no ejection progress
+        r0 = pool.replicas[0]
+        assert r0.consecutive_failures == 0
+        assert r0.failures == 0
+        # ...but its elapsed time DID feed the latency EWMA (chronic hedge
+        # losers must converge toward gray)
+        assert r0.req_ewma.samples == 1
+        assert r0.req_ewma.value >= 40.0
+        # the underlying HTTP request was truly torn down: the replica's
+        # handler observed the cancellation
+        for _ in range(50):
+            if replicas[0].cancelled:
+                break
+            await asyncio.sleep(0.02)
+        assert replicas[0].cancelled == 1
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_hedge_budget_exhaustion_degrades_to_unhedged_not_503():
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        replicas[0].delay_s = 0.3
+        pool = ReplicaPool(
+            urls,
+            hedge_after_s=0.05,
+            health_interval_s=30.0,
+            hedge_budget=RetryBudget(pct=0.0, min_retries=0),
+        )
+        t0 = time.perf_counter()
+        body = await pool.detect(PAYLOAD)  # primary is r0 (slow)
+        elapsed = time.perf_counter() - t0
+        # the budget refused the hedge: the request WAITED the primary out
+        # and still succeeded — budget exhaustion is never an error
+        assert body["served_by"] == "r0"
+        assert elapsed >= 0.25
+        assert pool.hedges_total == 0
+        assert pool.hedge_budget.exhausted_total == 1
+        assert pool.failures_total == 0
+        snap = pool.snapshot()
+        assert snap["pool_hedge_budget_exhausted_total"] == 1
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_hedge_composes_with_affinity_prefer_order():
+    async def run():
+        replicas, urls = await _with_replicas(3)
+        replicas[0].delay_s = 0.5  # the keyed owner is slow
+        pool = ReplicaPool(urls, hedge_after_s=0.05, health_interval_s=30.0)
+        prefer = [urls[0], urls[2], urls[1]]  # ring-ranked order for a key
+        resp = await pool.request("/detect", PAYLOAD, prefer=prefer)
+        body = resp.json()
+        # primary honored the prefer order (owner first); the hedge's
+        # backup came from the SAME ranked order — the next holder, not a
+        # random survivor
+        assert body["served_by"] == "r2"
+        assert pool.hedges_total == 1 and pool.hedge_wins_total == 1
+        assert replicas[1].detect_calls == 0
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_adaptive_hedge_end_to_end_masks_slow_replica():
+    """Warm the window on fast traffic, then slow one replica: the
+    adaptive trigger (observed p95) must fire hedges without any static
+    timer being configured."""
+
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        pool = ReplicaPool(
+            urls, adaptive_hedge=True, health_interval_s=30.0
+        )
+        for _ in range(24):  # warm past HEDGE_MIN_SAMPLES
+            await pool.detect(PAYLOAD)
+        assert pool.hedges_total == 0 or pool._hedge_trigger_s() is not None
+        replicas[0].delay_s = 1.0
+        t0 = time.perf_counter()
+        for _ in range(2):
+            body = await pool.detect(PAYLOAD)
+            assert body["served_by"] == "r1"
+        assert time.perf_counter() - t0 < 1.0
+        assert pool.hedges_total >= 1
+        assert pool.failures_total == 0
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+# ---- X-Spotter-Replica identity header (satellite) -------------------------
+
+
+def _build_stub_replica(replica_id: str):
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.serving.detector import AmenitiesDetector
+    from spotter_tpu.serving.standalone import make_app
+    from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+    engine = StubEngine(service_ms=0.0)
+    engine.metrics.set_identity(replica_id=replica_id)
+    det = AmenitiesDetector(
+        engine, MicroBatcher(engine, max_delay_ms=1.0), StubHttpClient()
+    )
+    return det, make_app(detector=det)
+
+
+def test_replica_header_at_replica_and_router():
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving import wire
+    from spotter_tpu.serving.router import make_router_app
+
+    async def run():
+        dets, servers, urls = [], [], []
+        for i in range(3):
+            det, app = _build_stub_replica(f"rep-{i}")
+            server = TestServer(app)
+            await server.start_server()
+            dets.append(det)
+            servers.append(server)
+            urls.append(f"http://{server.host}:{server.port}")
+        # replica surface: every /detect response names its producer
+        async with TestClient(servers[0]) as direct:
+            resp = await direct.post(
+                "/detect", json={"image_urls": ["http://img/0.jpg"]}
+            )
+            assert resp.headers[wire.REPLICA_HEADER] == "rep-0"
+        pool = ReplicaPool(urls, health_interval_s=0.2)
+        app = make_router_app(
+            pool, aggregator=FleetAggregator(lambda: [], interval_s=0.0)
+        )
+        async with TestClient(TestServer(app)) as client:
+            # single-owner: the edge echoes the one producing replica
+            resp = await client.post(
+                "/detect", json={"image_urls": ["http://img/1.jpg"]}
+            )
+            assert resp.status == 200
+            assert resp.headers[wire.REPLICA_HEADER].startswith("rep-")
+            # fan-out: every contributing replica id rides, comma-joined
+            many = [f"http://img/{i}.jpg" for i in range(12)]
+            resp = await client.post("/detect", json={"image_urls": many})
+            assert resp.status == 200
+            ids = resp.headers[wire.REPLICA_HEADER].split(",")
+            assert len(ids) >= 2  # 12 urls over 3 replicas: split for sure
+            assert all(i.startswith("rep-") for i in ids)
+        await pool.stop()
+        for server in servers:
+            await server.close()
+        for det in dets:
+            await det.aclose()
+
+    asyncio.run(run())
+
+
+# ---- the deterministic chaos matrix ----------------------------------------
+
+
+def _matrix_params():
+    from spotter_tpu.testing.chaos_matrix import GRAY_MATRIX
+
+    return [pytest.param(s, id=s.name) for s in GRAY_MATRIX]
+
+
+@pytest.mark.parametrize("scenario", _matrix_params())
+def test_chaos_matrix_scenario(scenario):
+    from spotter_tpu.testing.chaos_matrix import run_scenario
+
+    report = asyncio.run(run_scenario(scenario))
+    assert report["ok"], (
+        f"scenario {report['name']} failed {report['checks']}: {report}"
+    )

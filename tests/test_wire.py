@@ -142,6 +142,108 @@ def test_frame_rejects_garbage():
             wire.decode_frame(bad)
 
 
+# -- wire integrity: frame v2 checksums + fuzz (ISSUE 14) --------------------
+
+
+def test_frame_v2_layout_and_v1_interop():
+    """v2 is the default encoding (header + per-segment checksums); v1
+    frames (SPOTTER_TPU_WIRE_CRC=0, or an old peer) still decode."""
+    body = _sample_body(degraded=["stale"])
+    v2 = wire.encode_frame(body)
+    assert v2[4] == wire.FRAME_VERSION == 2
+    assert wire.decode_frame(v2) == body
+    header, segments = wire.strip_segments(body)
+    v1 = wire.build_frame(header, segments, crc=False)
+    assert v1[4] == wire.FRAME_VERSION_V1 == 1
+    assert wire.decode_frame(v1) == body
+    # the v2 integrity layer costs exactly 4 bytes + 4 per segment
+    assert len(v2) == len(v1) + 4 + 4 * len(segments)
+
+
+def test_frame_corruption_is_typed_never_garbage():
+    """A flipped bit in a CRC-protected region must raise
+    FrameCorruptError (a FrameError subclass), not decode to garbage."""
+    import pytest
+
+    frame = wire.encode_frame(_sample_body())
+    # flip one byte in the segment region (the JPEG tail)
+    bad = bytearray(frame)
+    bad[-2] ^= 0xFF
+    with pytest.raises(wire.FrameCorruptError):
+        wire.decode_frame(bytes(bad))
+    # and one in the header region (after the 20-byte preamble)
+    bad = bytearray(frame)
+    bad[24] ^= 0x01
+    with pytest.raises(wire.FrameCorruptError):
+        wire.decode_frame(bytes(bad))
+    assert issubclass(wire.FrameCorruptError, wire.FrameError)
+    # verify_frame (the pool validator body) raises the same way
+    with pytest.raises(wire.FrameCorruptError):
+        frame_bad = bytearray(frame)
+        frame_bad[-1] ^= 0x40
+        wire.verify_frame(bytes(frame_bad))
+    wire.verify_frame(frame)  # intact frame passes silently
+
+
+def test_frame_fuzz_truncation_and_bitflips_always_typed():
+    """The fuzz contract (ISSUE 14 satellite): ANY truncation and ANY
+    single-byte corruption of a valid frame raises FrameError (or its
+    FrameCorruptError subclass) — never struct.error, KeyError,
+    UnicodeDecodeError, or a silent garbage decode. Exhaustive over every
+    byte of a small frame plus seeded random multi-byte damage."""
+    import random
+
+    import pytest
+
+    frame = wire.encode_frame(_sample_body(degraded=["stale"]))
+    # every possible truncation
+    for i in range(len(frame)):
+        with pytest.raises(wire.FrameError):
+            wire.decode_frame(frame[:i])
+    # every single-byte flip: v2 checksums cover the preamble, header and
+    # segments, so nothing slips through as a silent/garbage decode
+    for i in range(len(frame)):
+        bad = bytearray(frame)
+        bad[i] ^= 0xFF
+        with pytest.raises(wire.FrameError):
+            wire.decode_frame(bytes(bad))
+    # seeded random multi-byte damage (flips + slices + garbage splices)
+    rng = random.Random(0xC0FFEE)
+    for _ in range(300):
+        bad = bytearray(frame)
+        for _ in range(rng.randint(1, 8)):
+            bad[rng.randrange(len(bad))] ^= 1 << rng.randrange(8)
+        if rng.random() < 0.3:
+            cut = rng.randrange(len(bad))
+            bad = bad[:cut] + bytearray(rng.randbytes(rng.randint(0, 32)))
+        try:
+            wire.decode_frame(bytes(bad))
+        except wire.FrameError:
+            pass  # typed — the contract
+        # any OTHER exception type propagates and fails the test
+
+
+def test_corrupt_frame_fault_flips_a_checked_byte():
+    """The chaos-matrix injection (faults.corrupt_frame_bytes) must damage
+    a CRC-protected region: armed -> the frame fails validation exactly N
+    times; unarmed -> identity."""
+    import pytest
+
+    from spotter_tpu.testing import faults
+
+    frame = wire.encode_frame(_sample_body())
+    assert faults.corrupt_frame_bytes(frame) == frame  # no plan: identity
+    with faults.inject(corrupt_frame=2):
+        first = faults.corrupt_frame_bytes(frame)
+        second = faults.corrupt_frame_bytes(frame)
+        third = faults.corrupt_frame_bytes(frame)  # armed count consumed
+    assert first != frame and second != frame
+    assert third == frame
+    for bad in (first, second):
+        with pytest.raises(wire.FrameCorruptError):
+            wire.decode_frame(bad)
+
+
 def test_negotiation_and_cache_summary():
     assert wire.wants_frame("application/x-spotter-frame")
     assert wire.wants_frame("application/json, application/x-spotter-frame;q=0.9")
