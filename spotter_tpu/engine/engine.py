@@ -292,6 +292,28 @@ class InferenceEngine:
         """Data-parallel width the serving batch is sharded over (1 = single chip)."""
         return int(self.mesh.shape["dp"]) if self.mesh is not None else 1
 
+    def weights_digest(self) -> str:
+        """Structural fingerprint of the loaded weights (ISSUE 15): a
+        digest over model name plus every param's path, shape and dtype.
+        Cheap (no device reads) and stable across processes, it catches
+        the deploy skew that matters for rollout identity — a different
+        checkpoint architecture, head count, or quantization layout behind
+        the same build tag. `SPOTTER_TPU_WEIGHTS_DIGEST` overrides it when
+        byte-exact provenance is available from the weights pipeline."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(str(getattr(self.built, "model_name", "")).encode())
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            self.built.params
+        ):
+            h.update(
+                f"{jax.tree_util.keystr(path)}:"
+                f"{tuple(getattr(leaf, 'shape', ()))}:"
+                f"{getattr(leaf, 'dtype', '?')}".encode()
+            )
+        return h.hexdigest()[:12]
+
     @property
     def tp(self) -> int:
         """Tensor-parallel width the params are split over (1 = whole params

@@ -36,6 +36,15 @@ STAGE_BUCKETS_MS = (
 )
 
 REPLICA_ID_ENV = "SPOTTER_TPU_REPLICA_ID"
+# Deployment version identity (ISSUE 15): the build/version tag this
+# replica is serving, stamped into the snapshot identity block, /healthz,
+# and the X-Spotter-Version response header. The rollout controller keys
+# canary-vs-baseline cohorts (and the pool keys replay/hedge pinning) on
+# exactly this string, so set it per deploy (image tag, git sha, model
+# rev). Unset -> "dev".
+BUILD_VERSION_ENV = "SPOTTER_TPU_BUILD_VERSION"
+WEIGHTS_DIGEST_ENV = "SPOTTER_TPU_WEIGHTS_DIGEST"
+DEFAULT_BUILD_VERSION = "dev"
 
 
 def _median(ring) -> float | None:
@@ -58,6 +67,16 @@ def default_replica_id() -> str:
     except OSError:
         host = "localhost"
     return f"{host}:{os.getpid()}"
+
+
+def default_build_version() -> str:
+    """The deploy version this process serves (env, else "dev")."""
+    return os.environ.get(BUILD_VERSION_ENV, "").strip() or DEFAULT_BUILD_VERSION
+
+
+def default_weights_digest() -> str | None:
+    """Operator-pinned weights digest, or None until an engine stamps one."""
+    return os.environ.get(WEIGHTS_DIGEST_ENV, "").strip() or None
 
 
 class Metrics:
@@ -93,6 +112,10 @@ class Metrics:
         self._replica_id = default_replica_id()
         self._model: str | None = None
         self._generation = 0
+        # Deployment identity (ISSUE 15): build version + weights digest —
+        # what the rollout verdict and mixed-version request pinning key on
+        self._version = default_build_version()
+        self._weights_digest = default_weights_digest()
         # Resilience counters (ISSUE 1): overload shedding, deadline expiry,
         # watchdog batch timeouts, breaker state/transitions, drain state.
         self._shed_total = 0
@@ -368,6 +391,8 @@ class Metrics:
         model: str | None = None,
         replica_id: str | None = None,
         generation: int | None = None,
+        version: str | None = None,
+        weights_digest: str | None = None,
     ) -> None:
         """Stamp the snapshot identity block (ISSUE 12). Only non-None
         fields change, so the bootstrap can stamp the model name without
@@ -379,6 +404,17 @@ class Metrics:
                 self._replica_id = replica_id
             if generation is not None:
                 self._generation = int(generation)
+            if version is not None:
+                self._version = version
+            if weights_digest is not None:
+                self._weights_digest = weights_digest
+
+    @property
+    def version(self) -> str:
+        """The identity stamp's build version (ISSUE 15: echoed as the
+        X-Spotter-Version response header at replica and edge)."""
+        with self._lock:
+            return self._version
 
     @property
     def replica_id(self) -> str:
@@ -567,6 +603,10 @@ class Metrics:
                     "generation": self._generation,
                     "uptime_s": round(now - self._started, 3),
                     "model": self._model,
+                    # deployment identity (ISSUE 15): which build/weights
+                    # this replica serves — the rollout verdict's cohort key
+                    "version": self._version,
+                    "weights_digest": self._weights_digest,
                 },
                 "stage_ms_histogram": stage_hists,
                 "padding_waste_pct": waste,

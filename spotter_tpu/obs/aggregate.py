@@ -482,6 +482,15 @@ class FleetAggregator:
 
     # ---- views ----
 
+    def member_snapshot(self, url: str) -> dict | None:
+        """The last successfully-scraped /metrics snapshot for one member
+        (None when never scraped). The rollout controller's verdict reads
+        canary and baseline-cohort signals (p99, errors, fast-window burn)
+        from exactly the view the fleet plane already maintains."""
+        with self._lock:
+            st = self._states.get(url.rstrip("/"))
+            return st.snapshot if st is not None else None
+
     def _is_stale(self, st: _MemberState, now: float) -> bool:
         if st.last_ok is None:
             return True
@@ -542,6 +551,11 @@ class FleetAggregator:
             "generation_resets": st.resets_total,
             "pid": rep.get("pid"),
             "model": rep.get("model"),
+            # deployment identity (ISSUE 15): which build each member
+            # serves — the /debug/fleet column that makes a mixed-version
+            # rollout window (and its canary) readable at a glance
+            "version": rep.get("version"),
+            "weights_digest": rep.get("weights_digest"),
             "uptime_s": rep.get("uptime_s"),
             "images_total": snap.get("images_total", 0),
             "images_per_sec": snap.get("images_per_sec", 0.0),

@@ -36,6 +36,8 @@ _LABELED_KEYS = {
     # (fast = 1 m, slow = 30 m) and HBM gauges labeled per device
     "slo_burn_rate": ("window",),
     "hbm_per_device": ("device", "stat"),
+    # deployment plane (ISSUE 15): one counter per rollout outcome
+    "rollouts_total": ("verdict",),
 }
 # keys whose dict values are {"p50": x, "p90": y, ...} quantile summaries
 # (the engine snapshot's slack_at_dispatch_ms, ISSUE 9) — rendered as a

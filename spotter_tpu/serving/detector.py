@@ -728,6 +728,10 @@ class AmenitiesDetector:
             "ready": ready,
             "breaker": breaker.state,
             "draining": draining,
+            # deployment identity (ISSUE 15): which build/weights this
+            # replica serves — a mixed-version window during a rollout is
+            # auditable per pod, same as the topology flags below
+            "version": self.engine.metrics.version,
             # ingest/topology config (ISSUE 3): which serving shape this
             # replica runs — dp width and whether preprocess is on-device —
             # so a fleet rollout of the new pipeline is auditable per pod
@@ -776,9 +780,13 @@ class AmenitiesDetector:
             "slo_burn": self.engine.metrics.perf.slo.block(),
         }
 
-    async def drain(self) -> dict:
-        """Stop admitting, flush the queue, wait for in-flight batches."""
-        return await self.batcher.drain()
+    async def drain(self, timeout_s: float | None = None) -> dict:
+        """Stop admitting, flush the queue, wait for in-flight batches.
+        `timeout_s` (ISSUE 15) overrides the env-default drain window —
+        the /drain handler maps its `deadline_ms` body field here so a
+        rollout retire (or k8s preStop) waits exactly as long as it can
+        afford."""
+        return await self.batcher.drain(timeout_s)
 
     async def aclose(self) -> None:
         await self.batcher.stop()

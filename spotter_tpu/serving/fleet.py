@@ -756,6 +756,9 @@ def make_fleet_app(
             rid = resp.headers.get(wire.REPLICA_HEADER)
             if rid:  # replica identity rides through the fleet edge too
                 out.headers[wire.REPLICA_HEADER] = rid
+            ver = resp.headers.get(wire.VERSION_HEADER)
+            if ver:  # deploy version too (ISSUE 15)
+                out.headers[wire.VERSION_HEADER] = ver
         return done(out)
 
     async def healthz(request: web.Request) -> web.Response:

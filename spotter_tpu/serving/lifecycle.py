@@ -155,6 +155,13 @@ class StartupTracker:
         self.error = error
 
     def snapshot(self) -> dict:
+        # deploy identity (ISSUE 15): a replica that is still loading
+        # already declares WHICH build is coming up — the rollout
+        # controller (and an operator watching a canary spawn) reads it
+        # from /startupz before the engine exists. Imported lazily so this
+        # module stays cheap for the supervisor's import path.
+        from spotter_tpu.engine.metrics import default_build_version
+
         return {
             "state": self._state,
             "ready": self.ready,
@@ -162,6 +169,7 @@ class StartupTracker:
             "time_to_ready_s": self.time_to_ready_s,
             "error": self.error,
             "pool": pool_from_env(),
+            "version": default_build_version(),
         }
 
 

@@ -88,6 +88,7 @@ from typing import Callable, Optional
 import httpx
 
 from spotter_tpu.serving.resilience import Ewma
+from spotter_tpu.serving.wire import VERSION_HEADER
 
 logger = logging.getLogger(__name__)
 
@@ -294,6 +295,14 @@ class Replica:
     soft_ejections: int = 0
     wrr_credit: float = 0.0  # smooth weighted round-robin accumulator
     prefer_credit: float = 0.0  # affinity-path thinning accumulator
+    # deployment identity (ISSUE 15): which build this replica serves —
+    # set by the rollout controller at membership time and kept fresh from
+    # the X-Spotter-Version response header. "" = unknown (pre-version
+    # fleets), which matches every pin.
+    version: str = ""
+    # externally pinned selection weight (rollout canary hold): None =
+    # unpinned; combined with the outlier-state weight by taking the min
+    pinned_weight: Optional[float] = None
     # diagnostics
     requests: int = 0
     failures: int = 0
@@ -406,6 +415,9 @@ class ReplicaPool:
         self.invalid_responses_total = 0  # validator rejections (frame CRC)
         self.failures_total = 0  # client-visible (pool exhausted)
         self.suspended_total = 0  # fast-failed: nothing worth trying
+        # mixed-version request pinning (ISSUE 15)
+        self.version_pinned_replays_total = 0
+        self.version_pin_relaxed_total = 0
 
     def _new_replica(self, url: str, healthy: bool = True) -> Replica:
         r = Replica(url=url, healthy=healthy)
@@ -440,6 +452,27 @@ class ReplicaPool:
             if r.url == url:
                 return r
         return None
+
+    def set_version(self, url: str, version: str) -> None:
+        """Pin a replica's deploy version (ISSUE 15). The rollout
+        controller calls this when it adds a canary so version pinning
+        works BEFORE the first response teaches the pool; live responses
+        keep it fresh afterwards (the X-Spotter-Version header)."""
+        r = self.replica_for(url)
+        if r is not None:
+            r.version = version
+
+    def set_weight(self, url: str, weight: Optional[float]) -> None:
+        """Pin (or with None clear) a replica's selection weight — the
+        rollout canary hold (ISSUE 15). Composes with the gray-failure
+        scorer by taking the min, so a gray canary is thinned even
+        further, never boosted."""
+        r = self.replica_for(url)
+        if r is not None:
+            r.pinned_weight = (
+                None if weight is None
+                else min(max(float(weight), 0.001), 1.0)
+            )
 
     def has_available(self) -> bool:
         now = time.monotonic()
@@ -642,16 +675,22 @@ class ReplicaPool:
                     )
 
     def _weight(self, r: Replica) -> float:
+        w = 1.0
         if r.outlier_state == OUTLIER_GRAY:
-            return self.outlier_weight
-        if r.outlier_state == OUTLIER_CANARY:
-            return CANARY_WEIGHT
-        return 1.0
+            w = self.outlier_weight
+        elif r.outlier_state == OUTLIER_CANARY:
+            w = CANARY_WEIGHT
+        if r.pinned_weight is not None:  # rollout canary hold (ISSUE 15)
+            w = min(w, r.pinned_weight)
+        return w
 
     # ---- routing ----
 
     def _pick(
-        self, exclude: set[str], prefer: Optional[list[str]] = None
+        self,
+        exclude: set[str],
+        prefer: Optional[list[str]] = None,
+        version: Optional[str] = None,
     ) -> Optional[Replica]:
         """Next replica to try. `prefer` (cache-affinity routing, ISSUE 11)
         is a ranked candidate order — the rendezvous ring's weight ordering
@@ -664,14 +703,24 @@ class ReplicaPool:
         recover) and hands the rest to the next-ranked holder. With the
         preference order exhausted (or absent) selection is round-robin
         while every candidate is at full weight, else smooth weighted
-        round-robin over the outlier weights."""
+        round-robin over the outlier weights.
+
+        `version` (ISSUE 15) restricts candidates to that deploy version
+        during a mixed-version window: a replica of unknown version ("")
+        always matches, so pre-version fleets are unaffected. Callers
+        decide the fallback policy when nothing matches (request() relaxes
+        the pin for replays; hedges stay strict)."""
         now = time.monotonic()
+
+        def version_ok(r: Replica) -> bool:
+            return not version or not r.version or r.version == version
+
         if prefer:
             for url in prefer:
                 if url in exclude:
                     continue
                 r = self.replica_for(url)
-                if r is None or not r.available(now):
+                if r is None or not r.available(now) or not version_ok(r):
                     continue
                 w = self._weight(r)
                 if w >= 1.0:
@@ -683,12 +732,16 @@ class ReplicaPool:
                 # thinned away this time: fall to the next-ranked holder
         candidates = [
             r for r in self.replicas
-            if r.url not in exclude and r.available(now)
+            if r.url not in exclude and r.available(now) and version_ok(r)
         ]
         if not candidates:
             return None
-        if all(r.outlier_state == OUTLIER_OK for r in candidates):
-            # the pre-ISSUE-14 behavior, bit-identical while nothing is gray
+        if all(
+            r.outlier_state == OUTLIER_OK and r.pinned_weight is None
+            for r in candidates
+        ):
+            # the pre-ISSUE-14 behavior, bit-identical while nothing is
+            # gray and no rollout canary holds a pinned weight
             return candidates[next(self._rr) % len(candidates)]
         # smooth weighted round-robin (the nginx algorithm): deterministic,
         # proportional to weight, and maximally spread — no RNG in routing
@@ -741,6 +794,12 @@ class ReplicaPool:
         resp = await self.client.post(
             f"{r.url}{path}", json=payload, headers=headers
         )
+        # version learning (ISSUE 15): every direct response names its
+        # build, so the pool's per-replica version map stays fresh with no
+        # extra round trips (fan-in responses are comma-joined and skipped)
+        ver = resp.headers.get(VERSION_HEADER, "")
+        if ver and "," not in ver:
+            r.version = ver
         if validator is not None and resp.status_code == 200:
             # wire-integrity check (ISSUE 14): a 200 whose body fails the
             # caller's validator (corrupt frame CRC) is a transport-shaped
@@ -809,18 +868,35 @@ class ReplicaPool:
         self._raise_if_suspended()
         last_err = ""
         first_attempt = True
+        # mixed-version pinning (ISSUE 15): once the first attempt lands on
+        # a versioned replica, replays prefer the SAME deploy version —
+        # during a rollout window a request must not be re-processed by an
+        # incompatible build. A replay relaxes the pin when no same-version
+        # candidate remains (the pinned attempt already failed; masking the
+        # failure beats skew purity). Hedges stay strict (_hedged_attempt):
+        # a hedge DOUBLE-processes by design, which is exactly what must
+        # never straddle two versions.
+        pinned_version: Optional[str] = None
         for round_idx in range(self.max_rounds):
             if round_idx:
                 await asyncio.sleep(self.round_pause_s)
             tried: set[str] = set()
             for attempt in range(len(self.replicas)):
-                r = self._pick(tried, prefer)
+                r = self._pick(tried, prefer, version=pinned_version)
+                if r is None and pinned_version is not None:
+                    self.version_pin_relaxed_total += 1
+                    pinned_version = None
+                    r = self._pick(tried, prefer)
                 if r is None:
                     if not self.has_available():
                         # everything got ejected mid-request (e.g. a storm
                         # took the last survivor): stop burning the deadline
                         self._raise_if_suspended()
                     break  # all available replicas tried — next round
+                if pinned_version is None and r.version:
+                    pinned_version = r.version
+                elif not first_attempt and pinned_version:
+                    self.version_pinned_replays_total += 1
                 if not first_attempt:
                     # about to replay: spend budget BEFORE the attempt, so a
                     # correlated failure cannot amplify offered load
@@ -888,7 +964,15 @@ class ReplicaPool:
         done, _ = await asyncio.wait({primary}, timeout=trigger_s)
         if done:
             return primary.result()  # success or raise-through to replay
-        backup_replica = self._pick(tried | {first.url}, prefer)
+        # version-strict backup (ISSUE 15): a hedge runs BOTH attempts to
+        # completion-or-cancel — the one shape that genuinely
+        # double-processes — so during a mixed-version window the backup
+        # must serve the primary's deploy version; with no same-version
+        # candidate the hedge is skipped (un-hedged waiting, never an
+        # error), exactly like an exhausted hedge budget.
+        backup_replica = self._pick(
+            tried | {first.url}, prefer, version=first.version or None
+        )
         if backup_replica is None:  # nowhere to hedge: wait the primary out
             return await primary
         if not self.hedge_budget.try_spend():
@@ -961,6 +1045,8 @@ class ReplicaPool:
             "pool_failures_total": self.failures_total,
             "pool_suspended_total": self.suspended_total,
             "pool_retry_budget_exhausted_total": self.retry_budget.exhausted_total,
+            "pool_version_pinned_replays_total": self.version_pinned_replays_total,
+            "pool_version_pin_relaxed_total": self.version_pin_relaxed_total,
             "retry_budget": self.retry_budget.snapshot(),
             "hedge": {
                 "adaptive": self.adaptive_hedge,
@@ -990,6 +1076,8 @@ class ReplicaPool:
                     "outlier_state": r.outlier_state,
                     "outlier_score": round(r.outlier_score, 3),
                     "weight": self._weight(r),
+                    "version": r.version,
+                    "pinned_weight": r.pinned_weight,
                     "req_ewma_ms": round(r.req_ewma.value, 3),
                     "probe_ewma_ms": round(r.probe_ewma.value, 3),
                     "soft_ejections": r.soft_ejections,

@@ -141,6 +141,7 @@ def make_router_app(
     affinity: bool | None = None,
     edge_negative_ttl_s: float | None = None,
     aggregator: FleetAggregator | None = None,
+    rollout=None,
 ) -> web.Application:
     """`limiter` (default: `SPOTTER_TPU_ADMIT_EDGE_TARGET_MS` via
     `edge_limiter_from_env`, None = off) adds the ISSUE 8 AIMD edge gate:
@@ -153,7 +154,12 @@ def make_router_app(
     members from `SPOTTER_TPU_FLEET_SCRAPE_S`, 2 s; 0 disables) is the
     ISSUE 12 fleet telemetry plane: member /metrics scraped and merged
     into a `fleet` block on this /metrics, the /debug/fleet per-replica
-    table, and /debug/traces?fleet=1 cross-replica trace stitching."""
+    table, and /debug/traces?fleet=1 cross-replica trace stitching.
+    `rollout` (ISSUE 15, default None) attaches a
+    `rollout.RolloutController`: its shadow lane mirrors sampled /detect
+    traffic to the canary (responses discarded, never client-visible) and
+    its state/counters ride /metrics under `rollout` — idle cost is one
+    None/state check per request."""
     if affinity is None:
         affinity = affinity_from_env()
     if edge_negative_ttl_s is None:
@@ -178,6 +184,7 @@ def make_router_app(
     app["edge_limiter"] = limiter
     app["edge_negative"] = negcache
     app["fleet_aggregator"] = aggregator
+    app["rollout"] = rollout
     # Edge SLO burn-rate (ISSUE 10): the device plane's burn windows,
     # measured at the edge over what CLIENTS saw — sheds (429/503) and
     # downstream 5xx spend the budget; everything else is good. This is
@@ -259,6 +266,9 @@ def make_router_app(
         rid = resp.headers.get(wire.REPLICA_HEADER)
         if rid:  # replica identity rides through the edge (ISSUE 14)
             out.headers[wire.REPLICA_HEADER] = rid
+        ver = resp.headers.get(wire.VERSION_HEADER)
+        if ver:  # deploy version rides through too (ISSUE 15)
+            out.headers[wire.VERSION_HEADER] = ver
         _record_response(len(resp.content), is_frame)
         return out
 
@@ -308,6 +318,7 @@ def make_router_app(
         downstream: list = []
         degraded: set[str] = set()
         replica_ids: list[str] = []
+        versions: list[str] = []
         if groups:
             aff_stats["routed_total"] += len(groups)
 
@@ -338,6 +349,9 @@ def make_router_app(
                 rid = resp.headers.get(wire.REPLICA_HEADER)
                 if rid and rid not in replica_ids:
                     replica_ids.append(rid)
+                ver = resp.headers.get(wire.VERSION_HEADER)
+                if ver and ver not in versions:
+                    versions.append(ver)
                 if len(groups) == 1 and not edge_answered:
                     return _passthrough(resp, client_frame), downstream
                 if resp.status_code != 200:
@@ -388,6 +402,10 @@ def make_router_app(
             # owner order (ISSUE 14): a slow merged response decomposes
             # back to the member(s) that served it
             out.headers[wire.REPLICA_HEADER] = ",".join(replica_ids)
+        if versions:
+            # every distinct deploy version that contributed (ISSUE 15): a
+            # >1-entry value IS the mixed-version-window signal
+            out.headers[wire.VERSION_HEADER] = ",".join(versions)
         _record_response(len(body), client_frame)
         return out, downstream
 
@@ -493,6 +511,16 @@ def make_router_app(
                 net_ms = elapsed_s * 1e3 - merged_max
                 if net_ms > 0.0:
                     trace.add_span_ms(obs_http.NETWORK, 0.0, net_ms)
+        # shadow lane (ISSUE 15): mirror this already-served request to the
+        # rollout canary on the sampled lane — fire-and-forget, response
+        # discarded, so nothing here can touch what the client got. Frame
+        # bodies are skipped (the lane compares JSON detections).
+        if (
+            rollout is not None
+            and out.status == 200
+            and not client_frame
+        ):
+            rollout.maybe_shadow(payload, out.body)
         return done(out)
 
     async def healthz(request: web.Request) -> web.Response:
@@ -565,6 +593,10 @@ def make_router_app(
         # fleet's goodput/burn/MFU right now".
         if aggregator.enabled:
             snap["fleet"] = aggregator.fleet_snapshot()
+        # deployment plane (ISSUE 15): rollout state machine + verdict +
+        # shadow-lane counters; prom renders rollouts_total{verdict=...}
+        if rollout is not None:
+            snap["rollout"] = rollout.snapshot()
         return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)

@@ -158,6 +158,20 @@ def make_app(
         )
         if model is not None:
             det.engine.metrics.set_identity(model=model)
+        # weights digest (ISSUE 15): engines that can fingerprint their
+        # loaded params expose weights_digest(); an operator-pinned
+        # SPOTTER_TPU_WEIGHTS_DIGEST (already stamped at Metrics init)
+        # outranks the computed one
+        from spotter_tpu.engine.metrics import default_weights_digest
+
+        digest_fn = getattr(det.engine, "weights_digest", None)
+        if digest_fn is not None and default_weights_digest() is None:
+            try:
+                digest = digest_fn() if callable(digest_fn) else digest_fn
+            except Exception:
+                digest = None
+            if digest:
+                det.engine.metrics.set_identity(weights_digest=str(digest))
 
     def _wire_fault_domain(det) -> None:
         det.batcher.attach_lifecycle(tracker)
@@ -235,10 +249,15 @@ def make_app(
             # replica identity header (ISSUE 14 satellite): every /detect
             # outcome — sheds and errors included — names the replica that
             # produced it, so a slow or corrupt response joins /debug/fleet
-            # rows and stitched traces by replica id
+            # rows and stitched traces by replica id. The deploy version
+            # rides along (ISSUE 15) so clients, edges and the rollout
+            # controller can attribute every response to a build.
             if det is not None:
                 resp.headers[wire.REPLICA_HEADER] = (
                     det.engine.metrics.replica_id
+                )
+                resp.headers[wire.VERSION_HEADER] = (
+                    det.engine.metrics.version
                 )
             return obs_http.finish_http_trace(
                 trace, request_id, resp, server_timing=True
@@ -247,7 +266,7 @@ def make_app(
         det = request.app["detector"]
         if det is None:  # still loading/warming: shed, probe /startupz
             return done(_not_ready_response(tracker))
-        if faults.take_flaky():
+        if faults.take_flaky(det.engine.metrics.replica_id):
             # injected intermittent failure (ISSUE 14 chaos matrix): the
             # gray-failure shape hard ejection can't see — a 500 rate below
             # the consecutive-failure threshold. 500 is a REPLAYABLE status
@@ -305,7 +324,9 @@ def make_app(
             # computed — the deterministic way to prove the edge CRC
             # validator catches, counts, and replays corruption
             resp = web.Response(
-                body=faults.corrupt_frame_bytes(wire.encode_frame(body)),
+                body=faults.corrupt_frame_bytes(
+                    wire.encode_frame(body), det.engine.metrics.replica_id
+                ),
                 content_type=wire.FRAME_CONTENT_TYPE,
             )
         else:
@@ -351,14 +372,31 @@ def make_app(
     async def drain(request: web.Request) -> web.Response:
         """k8s preStop: stop admitting, flush the queue, wait for in-flight
         batches. Idempotent — a second call reports the drained state.
-        Guarded by SPOTTER_TPU_ADMIN_TOKEN when set."""
+        Guarded by SPOTTER_TPU_ADMIN_TOKEN when set.
+
+        Body (optional JSON, ISSUE 15): {"deadline_ms": N} caps the wait;
+        the response reports `in_flight` (batches still running at the
+        deadline) and `queued_failed`, so a rollout controller or preStop
+        hook waits precisely instead of sleeping a fixed grace period."""
         rejected = _admin_rejection(request)
         if rejected is not None:
             return rejected
         det = request.app["detector"]
         if det is None:
             return _not_ready_response(tracker)
-        summary = await det.drain()
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            body = {}
+        timeout_s = None
+        if isinstance(body, dict) and "deadline_ms" in body:
+            try:
+                timeout_s = max(float(body["deadline_ms"]), 0.0) / 1000.0
+            except (TypeError, ValueError):
+                return web.Response(
+                    status=400, text="deadline_ms must be a number"
+                )
+        summary = await det.drain(timeout_s)
         return web.json_response(summary)
 
     async def metrics(request: web.Request) -> web.Response:

@@ -139,6 +139,13 @@ NEGATIVE_HEADER = "X-Spotter-Negative"
 # /debug/fleet rows and stitched traces by replica id without scraping.
 # Fan-in responses carry every contributing replica, comma-joined.
 REPLICA_HEADER = "X-Spotter-Replica"
+# Which deploy version produced this response (ISSUE 15): the identity
+# stamp's build version echoed at the replica and forwarded by the edge
+# (fan-in responses carry every distinct contributing version,
+# comma-joined). The pool learns per-replica versions from this header —
+# the substrate for mixed-version replay/hedge pinning and the rollout
+# verdict's canary-vs-baseline split.
+VERSION_HEADER = "X-Spotter-Version"
 
 # cap the per-verdict error text: headers are not a payload channel
 _MAX_ERROR_CHARS = 200

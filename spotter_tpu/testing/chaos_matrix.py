@@ -1,4 +1,4 @@
-"""Deterministic gray-failure chaos matrix (ISSUE 14).
+"""Deterministic gray-failure + deployment chaos matrix (ISSUE 14/15).
 
 The fleet chaos coverage grew scenario by scenario (kill a replica, storm
 the spot pool, poison a batch...), each hand-rolled in its own test. This
@@ -17,6 +17,18 @@ row, and `bench.py --gray-storm` is the measured (timed, gated) sibling of
 the `gray-slow` row. Scenarios are cheap (~a second each): the point is
 that adding a new gray-failure shape is one dataclass literal, not a new
 harness.
+
+ISSUE 15 adds the DEPLOYMENT half: `DeployScenario`/`DEPLOY_MATRIX` run a
+full versioned rollout (serving/rollout.py) over the same in-process
+topology — N v1 stub replicas behind the real pool + router, a
+RolloutController whose spawner produces the "new version" replica with a
+scripted defect (10x slow / Bresenham-deterministic flaky 500s / corrupt
+frames scoped to the canary via `faults.only_replica` / different
+detections for the shadow lane), live load the whole time. Bad deploys
+must AUTO-ROLLBACK with zero client-visible failures and a pinned
+flight-recorder trace; the good deploy must roll every member to v2 with
+zero failures. `tests/test_rollout.py` runs every row and
+`bench.py --rollout-drill` is the measured sibling.
 """
 
 import asyncio
@@ -261,4 +273,330 @@ def run_matrix(scenarios: list[Scenario] | None = None) -> list[dict]:
     reports = []
     for sc in scenarios if scenarios is not None else GRAY_MATRIX:
         reports.append(asyncio.run(run_scenario(sc)))
+    return reports
+
+
+# ---------------------------------------------------------------------------
+# deployment drills (ISSUE 15)
+
+
+@dataclass
+class DeployScenario:
+    """One deterministic deployment drill: a full rollout attempt over an
+    in-process stub fleet under live load.
+
+    `bad` names the new version's defect: None (a good deploy that must
+    promote every wave), "slow" (service time x `slow_factor` — the p99
+    verdict), "flaky" (`flaky_pct`% deterministic 500s scoped to the
+    canary — the error-rate verdict), "corrupt" (every canary frame
+    corrupted post-encode; clients negotiate frames so the edge CRC
+    validator feeds the error-rate verdict), or "diff" (the canary answers
+    DIFFERENT detections — only the shadow lane can see it).
+    `invariants` are exact checks over the final report."""
+
+    name: str
+    replicas: int = 3
+    concurrency: int = 4
+    service_ms: float = 5.0
+    bad: str | None = None
+    slow_factor: float = 10.0
+    flaky_pct: int = 20
+    frame: bool = False
+    window_s: float = 1.2
+    confirm_window_s: float = 0.5
+    min_requests: int = 8
+    shadow_pct: float = 50.0
+    canary_weight: float = 0.1
+    tail_requests: int = 10  # post-terminal probes: the fleet still serves
+    invariants: dict = field(default_factory=dict)
+
+
+DEPLOY_MATRIX = [
+    DeployScenario(
+        name="good-deploy",
+        invariants={
+            "client_failures": 0,
+            "state": "done",
+            "fleet_all_v2": True,
+            "promoted_rollouts": 1,
+        },
+    ),
+    DeployScenario(
+        name="bad-deploy-slow",
+        bad="slow",
+        invariants={
+            "client_failures": 0,
+            "state": "rolled_back",
+            "reason": "p99_vs_baseline",
+            "canary_gone": True,
+            "fleet_size": 3,
+            "rolled_back_rollouts": 1,
+            "trace_pinned": True,
+        },
+    ),
+    DeployScenario(
+        name="bad-deploy-flaky",
+        bad="flaky",
+        invariants={
+            "client_failures": 0,
+            "state": "rolled_back",
+            "reason": "error_rate",
+            "canary_gone": True,
+            "fleet_size": 3,
+            "trace_pinned": True,
+        },
+    ),
+    DeployScenario(
+        name="bad-deploy-corrupt",
+        bad="corrupt",
+        frame=True,
+        invariants={
+            "client_failures": 0,
+            "state": "rolled_back",
+            "reason": "error_rate",
+            "invalid_responses_gt": 0,
+            "canary_gone": True,
+            "trace_pinned": True,
+        },
+    ),
+    DeployScenario(
+        name="bad-deploy-wrong-output",
+        bad="diff",
+        invariants={
+            "client_failures": 0,
+            "state": "rolled_back",
+            "reason": "shadow_diff",
+            "canary_gone": True,
+            "trace_pinned": True,
+        },
+    ),
+]
+
+
+class _InProcMember:
+    """In-process rollout member handle: a real aiohttp TestServer over a
+    stub detector, closable from the controller's retire path."""
+
+    def __init__(self, server, det, version: str) -> None:
+        self.server = server
+        self.det = det
+        self.version = version
+        self.url = f"http://{server.host}:{server.port}"
+
+    async def shutdown(self) -> None:
+        await self.server.close()
+        await self.det.aclose()
+
+
+async def _spawn_stub_member(
+    replica_id: str, version: str, service_ms: float,
+    detections: list | None = None,
+) -> "_InProcMember":
+    from aiohttp.test_utils import TestServer
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.serving.detector import AmenitiesDetector
+    from spotter_tpu.serving.standalone import make_app
+    from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+    engine = StubEngine(service_ms=service_ms, detections=detections)
+    engine.metrics.set_identity(replica_id=replica_id, version=version)
+    engine.metrics.set_identity(weights_digest=engine.weights_digest())
+    det = AmenitiesDetector(
+        engine, MicroBatcher(engine, max_delay_ms=1.0), StubHttpClient()
+    )
+    server = TestServer(make_app(detector=det))
+    await server.start_server()
+    return _InProcMember(server, det, version)
+
+
+async def run_deploy_scenario(sc: DeployScenario) -> dict:
+    """Execute one deployment drill; returns the report dict."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu import obs
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving import wire
+    from spotter_tpu.serving.replica_pool import ReplicaPool
+    from spotter_tpu.serving.rollout import DONE, ROLLED_BACK, RolloutController
+    from spotter_tpu.serving.router import make_router_app
+    from spotter_tpu.testing.stub_engine import STUB_DETECTIONS
+
+    obs.reset_recorder()  # scenario isolation for the pinned-trace check
+    members = [
+        await _spawn_stub_member(f"deploy-r{i}", "v1", sc.service_ms)
+        for i in range(sc.replicas)
+    ]
+    pool = ReplicaPool(
+        [m.url for m in members], health_interval_s=0.05
+    )
+    for m in members:
+        pool.set_version(m.url, "v1")
+    aggregator = FleetAggregator(
+        lambda: [r.url for r in pool.replicas], interval_s=0.2
+    )
+
+    canary_service = sc.service_ms * (
+        sc.slow_factor if sc.bad == "slow" else 1.0
+    )
+    canary_detections = (
+        [{"label": "oven", "score": 0.4, "box": [1.0, 1.0, 9.0, 9.0]}]
+        if sc.bad == "diff"
+        else None
+    )
+
+    def spawner():
+        return _spawn_stub_member(
+            "deploy-canary", "v2", canary_service, canary_detections
+        )
+
+    controller = RolloutController(
+        pool,
+        members=list(members),
+        spawner=spawner,
+        version_to="v2",
+        version_from="v1",
+        aggregator=aggregator,
+        canary_weight=sc.canary_weight,
+        window_s=sc.window_s,
+        confirm_window_s=sc.confirm_window_s,
+        min_requests=sc.min_requests,
+        max_error_rate=0.05,
+        shadow_pct=sc.shadow_pct,
+        drain_deadline_ms=2000.0,
+        spawn_wait_s=10.0,
+        tick_s=0.05,
+    )
+    app = make_router_app(pool, aggregator=aggregator, rollout=controller)
+
+    fault_plan = {}
+    if sc.bad == "flaky":
+        fault_plan = {"flaky": sc.flaky_pct, "only_replica": "deploy-canary"}
+    elif sc.bad == "corrupt":
+        fault_plan = {"corrupt_frame": -1, "only_replica": "deploy-canary"}
+
+    client_failures = 0
+    requests_done = 0
+    statuses: dict[int, int] = {}
+    headers = {"Accept": wire.FRAME_CONTENT_TYPE} if sc.frame else {}
+
+    async with TestClient(TestServer(app)) as client:
+
+        async def one_request(i: int) -> None:
+            nonlocal client_failures, requests_done
+            resp = await client.post(
+                "/detect",
+                json={"image_urls": [URL_CYCLE[i % len(URL_CYCLE)]]},
+                headers=headers,
+            )
+            await resp.read()
+            requests_done += 1
+            statuses[resp.status] = statuses.get(resp.status, 0) + 1
+            if resp.status != 200:
+                client_failures += 1
+
+        async def worker() -> None:
+            i = 0
+            while controller.state not in (DONE, ROLLED_BACK):
+                await one_request(i)
+                i += 1
+
+        with faults.inject(**fault_plan):
+            rollout_task = asyncio.create_task(controller.run())
+            workers = [
+                asyncio.create_task(worker())
+                for _ in range(sc.concurrency)
+            ]
+            await asyncio.wait_for(rollout_task, timeout=60.0)
+            await asyncio.gather(*workers)
+        # post-terminal probes: the fleet must still serve cleanly after a
+        # rollback (old members restored) or a full roll (all new members)
+        for i in range(sc.tail_requests):
+            await one_request(i)
+
+        pool_snap = pool.snapshot()
+        rollout_snap = controller.snapshot()
+        await controller.stop()
+
+    # members the controller retired were already shut down by its retire
+    # path; everything still in the pool is ours to close
+    for m in members + controller.new_members:
+        if pool.replica_for(m.url) is not None:
+            try:
+                await m.shutdown()
+            except Exception:
+                pass
+    await pool.stop()
+    await aggregator.stop()
+
+    rec = obs.get_recorder().snapshot()
+    pinned = any(
+        str(t.get("request_id", "")).startswith("rollout-rollback")
+        for t in rec.get("errors", []) + rec.get("ring", [])
+    )
+    report = {
+        "name": sc.name,
+        "statuses": statuses,
+        "requests": requests_done,
+        "client_failures": client_failures,
+        "state": rollout_snap["state"],
+        "reason": rollout_snap["rollback_reason"],
+        "last_verdict": rollout_snap["last_verdict"],
+        "rollouts_total": rollout_snap["rollouts_total"],
+        "shadow": rollout_snap["shadow"],
+        "invalid_responses": pool_snap["pool_invalid_responses_total"],
+        "fleet_versions": [r["version"] for r in pool_snap["replicas"]],
+        "fleet_size": len(pool_snap["replicas"]),
+        "canary_in_pool": any(
+            r["url"] == (rollout_snap["canary_url"] or "")
+            for r in pool_snap["replicas"]
+        ),
+        "trace_pinned": pinned,
+        "replica_snapshots": pool_snap["replicas"],
+    }
+    report["checks"] = evaluate_deploy(sc, report)
+    report["ok"] = all(report["checks"].values())
+    return report
+
+
+def evaluate_deploy(sc: DeployScenario, report: dict) -> dict:
+    """Invariant name -> bool for every invariant the scenario declares."""
+    checks: dict[str, bool] = {}
+    for key, want in sc.invariants.items():
+        if key == "client_failures":
+            checks[key] = report["client_failures"] == want
+        elif key == "state":
+            checks[key] = report["state"] == want
+        elif key == "reason":
+            checks[key] = report["reason"] == want
+        elif key == "canary_gone":
+            checks[key] = (not report["canary_in_pool"]) == want
+        elif key == "fleet_size":
+            checks[key] = report["fleet_size"] == want
+        elif key == "fleet_all_v2":
+            checks[key] = (
+                bool(report["fleet_versions"])
+                and all(v == "v2" for v in report["fleet_versions"])
+            ) == want
+        elif key == "promoted_rollouts":
+            checks[key] = report["rollouts_total"]["promoted"] == want
+        elif key == "rolled_back_rollouts":
+            checks[key] = report["rollouts_total"]["rolled_back"] == want
+        elif key == "invalid_responses_gt":
+            checks[key] = report["invalid_responses"] > want
+        elif key == "trace_pinned":
+            checks[key] = report["trace_pinned"] == want
+        else:
+            raise ValueError(f"unknown invariant {key!r} in {sc.name}")
+    return checks
+
+
+def run_deploy_matrix(
+    scenarios: list[DeployScenario] | None = None,
+) -> list[dict]:
+    """Run every deployment drill (fresh event loop each); returns the
+    reports — same contract as `run_matrix`."""
+    reports = []
+    for sc in scenarios if scenarios is not None else DEPLOY_MATRIX:
+        reports.append(asyncio.run(run_deploy_scenario(sc)))
     return reports

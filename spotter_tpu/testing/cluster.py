@@ -196,6 +196,28 @@ class FleetMember(SupervisedReplica):
             pass
 
 
+def rollout_spawner(workdir: str, version: str, pool: str = "on_demand",
+                    env: dict | None = None, **replica_kwargs):
+    """Factory for `RolloutController`'s spawner over REAL subprocess
+    members (ISSUE 15): each call spawns one supervised stub replica with
+    `SPOTTER_TPU_BUILD_VERSION=<version>` in its environment, so the
+    child stamps the version into its identity block and every
+    `X-Spotter-Version` header — the cross-process form of the in-process
+    drill members `testing/chaos_matrix.py` builds. The returned member
+    carries a `version` attribute the controller reads at adoption."""
+    member_env = {"SPOTTER_TPU_BUILD_VERSION": version}
+    if env:
+        member_env.update(env)
+    base = fleet_spawner(workdir, pool, env=member_env, **replica_kwargs)
+
+    def spawn() -> FleetMember:
+        member = base()
+        member.version = version
+        return member
+
+    return spawn
+
+
 def fleet_spawner(workdir: str, pool: str, env: dict | None = None,
                   **replica_kwargs):
     """Factory for `FleetController` PoolSpec.spawner: each call spawns one

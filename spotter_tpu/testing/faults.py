@@ -134,6 +134,13 @@ class FaultPlan:
     slow_replica: float = 0.0
     flaky: int = 0
     corrupt_frame: int = 0
+    # ISSUE 15 deployment drills: scope the gray-failure-tier injections
+    # (slow_replica / flaky / corrupt_frame) to ONE replica id. Subprocess
+    # fleets get per-replica faults for free (each process reads its own
+    # SPOTTER_TPU_FAULTS); this is the in-process equivalent — the chaos
+    # matrix runs N stub replicas in one process and only the "bad deploy"
+    # canary must misbehave. Empty = unscoped (every replica).
+    only_replica: str = ""
     # set() to un-wedge hanging engine calls early (tests)
     release: threading.Event = field(default_factory=threading.Event)
     _lock: threading.Lock = field(default_factory=threading.Lock)
@@ -200,11 +207,15 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "slow_replica",
             "flaky",
             "corrupt_frame",
+            "only_replica",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
         if key == "slow_stage":
             kwargs[key] = value.strip()
             _parse_slow_stage(kwargs[key])  # fail loudly at activation
+            continue
+        if key == "only_replica":
+            kwargs[key] = value.strip()
             continue
         try:
             if key.endswith("_s") or key == "slow_replica":  # durations
@@ -371,7 +382,16 @@ def on_shard_probe(device_id: int) -> None:
 # ---- gray-failure tier (ISSUE 14) ----
 
 
-def replica_delay_s() -> float:
+def _in_scope(plan: FaultPlan, replica_id: str | None) -> bool:
+    """Replica scoping (ISSUE 15): an `only_replica` plan only fires for
+    the matching replica id; an unscoped plan fires everywhere (the
+    pre-ISSUE-15 behavior — callers that don't pass an id keep it)."""
+    return not plan.only_replica or (
+        replica_id is not None and replica_id == plan.only_replica
+    )
+
+
+def replica_delay_s(replica_id: str | None = None) -> float:
     """Whole-replica slowdown for this process (seconds per engine call);
     0.0 when no plan is active — the usual single None check. The stub
     engine sleeps this inside its `device` stage window so the slowdown is
@@ -379,15 +399,19 @@ def replica_delay_s() -> float:
     plan = _active
     if plan is None or plan.slow_replica <= 0:
         return 0.0
+    if not _in_scope(plan, replica_id):
+        return 0.0
     return plan.slow_replica / 1000.0
 
 
-def take_flaky() -> bool:
+def take_flaky(replica_id: str | None = None) -> bool:
     """/detect handler hook: True when THIS request should answer 500.
     Deterministic Bresenham-style thinning — `flaky=25` fails exactly every
     4th request, no RNG — so chaos-matrix scenarios assert exact counts."""
     plan = _active
     if plan is None or plan.flaky <= 0:
+        return False
+    if not _in_scope(plan, replica_id):
         return False
     with plan._lock:
         plan._flaky_credit += min(plan.flaky, 100)
@@ -397,12 +421,14 @@ def take_flaky() -> bool:
     return False
 
 
-def corrupt_frame_bytes(data: bytes) -> bytes:
+def corrupt_frame_bytes(data: bytes, replica_id: str | None = None) -> bytes:
     """Response-encode hook: while armed, flip one byte near the tail of
     the encoded frame (segment bytes — a CRC-protected region) and consume
     one `corrupt_frame` unit. Identity when not armed."""
     plan = _active
-    if plan is None or not data or not plan._consume("corrupt_frame"):
+    if plan is None or not data or not _in_scope(plan, replica_id):
+        return data
+    if not plan._consume("corrupt_frame"):
         return data
     idx = max(len(data) - 2, 0)
     return data[:idx] + bytes([data[idx] ^ 0xFF]) + data[idx + 1:]

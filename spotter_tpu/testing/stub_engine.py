@@ -40,13 +40,23 @@ def stub_image_bytes(w: int = 32, h: int = 32, fill: int = 128) -> bytes:
 class StubEngine:
     """Duck-typed InferenceEngine: metrics + batch_buckets + detect()."""
 
-    def __init__(self, service_ms: float | None = None) -> None:
+    def __init__(
+        self,
+        service_ms: float | None = None,
+        detections: list[dict] | None = None,
+    ) -> None:
         from spotter_tpu.engine.metrics import Metrics
 
         if service_ms is None:
             raw = os.environ.get(STUB_SERVICE_MS_ENV, "").strip()
             service_ms = float(raw) if raw else 0.0
         self.service_s = max(service_ms, 0.0) / 1000.0
+        # `detections` overrides the canned output (ISSUE 15: a "new
+        # version" stub whose answers DIFFER is how the shadow lane's
+        # detection-diff verdict is exercised model-free)
+        self.detections = (
+            detections if detections is not None else STUB_DETECTIONS
+        )
         self.metrics = Metrics()
         # identity stamp (ISSUE 12): stub fleets exercise the same
         # mergeable-snapshot contract the real engine carries, so the
@@ -54,6 +64,14 @@ class StubEngine:
         # the model-free chaos/bench harnesses too
         self.metrics.set_identity(model="stub")
         self.batch_buckets = (1, 2, 4, 8)
+
+    def weights_digest(self) -> str:
+        """Content fingerprint of this stub's canned output (ISSUE 15):
+        the same role the real engine's param digest plays — two stubs
+        with different detections report different digests."""
+        return hashlib.sha256(
+            repr(self.detections).encode()
+        ).hexdigest()[:12]
 
     def warmup(self) -> None:  # parity with InferenceEngine's surface
         pass
@@ -78,14 +96,14 @@ class StubEngine:
         # process's every engine call slower inside the device window —
         # /healthz stays green while /detect latency grows, the signature
         # the pool's outlier score must catch
-        delay_s = faults.replica_delay_s()
+        delay_s = faults.replica_delay_s(self.metrics.replica_id)
         if delay_s > 0:
             time.sleep(delay_s)
         if self.service_s > 0:
             time.sleep(self.service_s)
         t_dev = time.monotonic()
         faults.sleep_stage(obs.POSTPROCESS)
-        out = [list(STUB_DETECTIONS) for _ in images]
+        out = [list(self.detections) for _ in images]
         t_post = time.monotonic()
         stage_windows = [
             (obs.DECODE, t0, t_decode),

@@ -13,7 +13,11 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from optax import assignment
+
+try:  # optax < the release that added the jittable Hungarian solver
+    from optax import assignment
+except ImportError:  # pragma: no cover - env-dependent
+    assignment = None
 
 from spotter_tpu.ops.boxes import center_to_corners, generalized_box_iou
 
@@ -78,6 +82,11 @@ def hungarian_match(
     Invalid (padding) targets still receive a (meaningless) query index;
     callers mask with `targets.valid`.
     """
+    if assignment is None:
+        raise ImportError(
+            "hungarian_match needs optax.assignment (optax too old in this "
+            "environment); training is unavailable, serving is unaffected"
+        )
 
     def one(logits_i, boxes_i, labels_i, tboxes_i, valid_i):
         cost = _matching_cost(

@@ -426,3 +426,121 @@ def test_default_budget_floor_preserves_single_death_failover():
         await replicas[0].stop()
 
     asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# mixed-version request pinning (ISSUE 15)
+
+
+def test_replay_pins_to_first_attempt_version():
+    """During a mixed-version window a failed attempt replays onto a
+    replica of the SAME deploy version: a request must never be
+    re-processed by an incompatible build while a same-version candidate
+    exists."""
+
+    async def run():
+        replicas, urls = await _with_replicas(3)
+        replicas[0].status = 500  # the v1 replica that fails
+        pool = ReplicaPool(urls, health_interval_s=30.0)
+        pool.set_version(urls[0], "v1")
+        pool.set_version(urls[1], "v1")
+        pool.set_version(urls[2], "v2")
+        # force the first attempt onto the failing v1 replica
+        body = (
+            await pool.request("/detect", PAYLOAD, prefer=[urls[0]])
+        ).json()
+        assert body["served_by"] == "r1"  # the same-version survivor
+        assert replicas[2].detect_calls == 0  # v2 never touched
+        snap = pool.snapshot()
+        assert snap["pool_version_pinned_replays_total"] == 1
+        assert snap["pool_version_pin_relaxed_total"] == 0
+        versions = {r["url"]: r["version"] for r in snap["replicas"]}
+        assert versions == {urls[0]: "v1", urls[1]: "v1", urls[2]: "v2"}
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_replay_relaxes_pin_when_no_same_version_left():
+    """With no same-version candidate left, availability beats skew
+    purity: the replay relaxes the pin (counted) instead of failing the
+    client."""
+
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        replicas[0].status = 500
+        pool = ReplicaPool(urls, health_interval_s=30.0)
+        pool.set_version(urls[0], "v1")
+        pool.set_version(urls[1], "v2")
+        body = (
+            await pool.request("/detect", PAYLOAD, prefer=[urls[0]])
+        ).json()
+        assert body["served_by"] == "r1"
+        snap = pool.snapshot()
+        assert snap["pool_version_pin_relaxed_total"] == 1
+        assert pool.failures_total == 0  # nothing client-visible
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_hedge_is_version_strict():
+    """A hedge double-processes by design — exactly what must never
+    straddle two versions: with only a cross-version backup available the
+    hedge is skipped (un-hedged waiting, no error); a same-version backup
+    restores hedging."""
+
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        replicas[0].delay_s = 0.25  # slow primary: the hedge trigger fires
+        pool = ReplicaPool(urls, health_interval_s=30.0, hedge_after_s=0.05)
+        pool.set_version(urls[0], "v1")
+        pool.set_version(urls[1], "v2")
+        body = (
+            await pool.request("/detect", PAYLOAD, prefer=[urls[0]])
+        ).json()
+        assert body["served_by"] == "r0"  # waited the slow primary out
+        assert pool.hedges_total == 0  # no same-version backup: no hedge
+        assert replicas[1].detect_calls == 0
+        # same build on both: the hedge fires and the fast backup wins
+        pool.set_version(urls[1], "v1")
+        body = (
+            await pool.request("/detect", PAYLOAD, prefer=[urls[0]])
+        ).json()
+        assert body["served_by"] == "r1"
+        assert pool.hedges_total == 1
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_pinned_weight_holds_canary_share():
+    """The rollout canary hold: a pinned weight caps a replica's share of
+    blind round-robin traffic via the smooth-weighted-RR path."""
+
+    async def run():
+        replicas, urls = await _with_replicas(3)
+        pool = ReplicaPool(urls, health_interval_s=30.0)
+        pool.set_weight(urls[2], 0.1)
+        for _ in range(60):
+            await pool.detect(PAYLOAD)
+        share = replicas[2].detect_calls / 60.0
+        # 0.1 / (1 + 1 + 0.1) ~ 4.8%; generous bound still proves the hold
+        assert share < 0.15, f"canary share {share:.2f}"
+        # clearing the pin restores plain round-robin
+        pool.set_weight(urls[2], None)
+        before = replicas[2].detect_calls
+        for _ in range(30):
+            await pool.detect(PAYLOAD)
+        assert replicas[2].detect_calls - before >= 8
+        await pool.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,338 @@
+"""Safe deployment plane (ISSUE 15): version-identity plumbing, the
+precise /drain contract, the shadow lane, and the full deployment chaos
+drills (testing/chaos_matrix.py::DEPLOY_MATRIX) — a bad deploy must
+auto-rollback with zero client-visible failures and a pinned
+flight-recorder trace; a good deploy must roll every member."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from spotter_tpu.engine.batcher import MicroBatcher
+from spotter_tpu.obs.aggregate import FleetAggregator
+from spotter_tpu.serving import wire
+from spotter_tpu.serving.detector import AmenitiesDetector
+from spotter_tpu.serving.replica_pool import ReplicaPool
+from spotter_tpu.serving.rollout import (
+    DONE,
+    RolloutController,
+    ShadowLane,
+    _norm_detections,
+)
+from spotter_tpu.serving.router import make_router_app
+from spotter_tpu.serving.standalone import make_app
+from spotter_tpu.testing.chaos_matrix import (
+    DEPLOY_MATRIX,
+    run_deploy_scenario,
+)
+from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+PAYLOAD = {"image_urls": ["http://example.com/room.jpg"]}
+
+
+def _stub_detector(version: str | None = None, service_ms: float = 0.0):
+    engine = StubEngine(service_ms=service_ms)
+    if version is not None:
+        engine.metrics.set_identity(version=version)
+    engine.metrics.set_identity(weights_digest=engine.weights_digest())
+    return AmenitiesDetector(
+        engine, MicroBatcher(engine, max_delay_ms=1.0), StubHttpClient()
+    )
+
+
+async def _stub_server(version: str | None = None):
+    det = _stub_detector(version)
+    server = TestServer(make_app(detector=det))
+    await server.start_server()
+    return det, server, f"http://{server.host}:{server.port}"
+
+
+# ---------------------------------------------------------------------------
+# version identity (satellite 4)
+
+
+def test_version_header_and_identity_at_replica():
+    """Every /detect outcome carries X-Spotter-Version, and the /metrics
+    identity block carries build version + weights digest."""
+
+    async def run():
+        det, server, _url = await _stub_server(version="v7")
+        async with TestClient(server) as client:
+            resp = await client.post("/detect", json=PAYLOAD)
+            assert resp.status == 200
+            assert resp.headers[wire.VERSION_HEADER] == "v7"
+            # a shed outcome names its version too
+            await det.drain()
+            resp = await client.post("/detect", json=PAYLOAD)
+            assert resp.status == 503
+            assert resp.headers[wire.VERSION_HEADER] == "v7"
+            m = await client.get("/metrics")
+            snap = await m.json()
+            assert snap["replica"]["version"] == "v7"
+            assert snap["replica"]["weights_digest"]
+            assert len(snap["replica"]["weights_digest"]) == 12
+        await det.aclose()
+
+    asyncio.run(run())
+
+
+def test_version_default_and_healthz():
+    """Unset SPOTTER_TPU_BUILD_VERSION -> "dev"; /healthz reports it."""
+
+    async def run():
+        det, server, _url = await _stub_server()
+        async with TestClient(server) as client:
+            h = await client.get("/healthz")
+            body = await h.json()
+            assert body["version"] == "dev"
+        await det.aclose()
+
+    asyncio.run(run())
+
+
+def test_router_version_passthrough_and_fanin_join():
+    """Single-owner responses pass the version header through unchanged;
+    a fan-in across mixed-version owners joins the distinct versions —
+    the mixed-version-window signal a client can observe directly."""
+
+    async def run():
+        det1, server1, url1 = await _stub_server(version="v1")
+        det2, server2, url2 = await _stub_server(version="v2")
+        pool = ReplicaPool([url1, url2], health_interval_s=30.0)
+        app = make_router_app(
+            pool, aggregator=FleetAggregator(lambda: [], interval_s=0.0)
+        )
+        async with TestClient(TestServer(app)) as client:
+            # single URL -> single owner -> passthrough (one version)
+            resp = await client.post("/detect", json=PAYLOAD)
+            assert resp.status == 200
+            assert resp.headers[wire.VERSION_HEADER] in ("v1", "v2")
+            # 16 distinct URLs rendezvous-spread over both owners: the
+            # fan-in joins both contributing versions
+            many = {
+                "image_urls": [
+                    f"http://example.com/img-{i}.jpg" for i in range(16)
+                ]
+            }
+            resp = await client.post("/detect", json=many)
+            assert resp.status == 200
+            versions = set(
+                resp.headers[wire.VERSION_HEADER].split(",")
+            )
+            assert versions == {"v1", "v2"}
+        await pool.stop()
+        for det, server in ((det1, server1), (det2, server2)):
+            await server.close()
+            await det.aclose()
+
+    asyncio.run(run())
+
+
+def test_fleet_edge_version_passthrough():
+    from spotter_tpu.serving.fleet import make_fleet_app, static_fleet
+
+    async def run():
+        det, server, url = await _stub_server(version="v3")
+        controller = static_fleet([url], [])
+        app = make_fleet_app(
+            controller,
+            aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+        )
+        async with TestClient(TestServer(app)) as client:
+            for _ in range(40):  # wait for the pool's health promotion
+                resp = await client.post("/detect", json=PAYLOAD)
+                if resp.status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            assert resp.status == 200
+            assert resp.headers[wire.VERSION_HEADER] == "v3"
+        await server.close()
+        await det.aclose()
+
+    asyncio.run(run())
+
+
+def test_pool_learns_version_from_response_header():
+    async def run():
+        det, server, url = await _stub_server(version="v9")
+        pool = ReplicaPool([url], health_interval_s=30.0)
+        assert pool.replica_for(url).version == ""
+        await pool.request("/detect", PAYLOAD)
+        assert pool.replica_for(url).version == "v9"
+        await pool.stop()
+        await server.close()
+        await det.aclose()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# precise drain (satellite 3)
+
+
+def test_drain_deadline_and_in_flight_count():
+    async def run():
+        det, server, _url = await _stub_server()
+        async with TestClient(server) as client:
+            resp = await client.post("/drain", json={"deadline_ms": 500})
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["status"] == "drained"
+            assert body["in_flight"] == 0
+            assert body["queued_failed"] == 0
+        await det.aclose()
+
+    asyncio.run(run())
+
+
+def test_drain_rejects_bad_deadline():
+    async def run():
+        det, server, _url = await _stub_server()
+        async with TestClient(server) as client:
+            resp = await client.post(
+                "/drain", json={"deadline_ms": "soon"}
+            )
+            assert resp.status == 400
+        await det.aclose()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# shadow lane units
+
+
+def test_shadow_sampling_is_deterministic():
+    lane = ShadowLane(pct=25.0)
+    took = [lane.take() for _ in range(100)]
+    assert sum(took) == 25
+    # Bresenham, not random: exactly every 4th draw
+    assert took[:8] == [False, False, False, True] * 2
+    assert ShadowLane(pct=0.0).take() is False
+
+
+def test_norm_detections_diff_semantics():
+    a = [{"detections": [{"label": "tv", "score": 0.901}]}]
+    b = [{"detections": [{"label": "tv", "score": 0.899}]}]
+    c = [{"detections": [{"label": "oven", "score": 0.901}]}]
+    assert _norm_detections(a) == _norm_detections(b)  # 2dp-stable
+    assert _norm_detections(a) != _norm_detections(c)  # real diff
+    assert _norm_detections([]) == []
+
+
+def test_rollout_with_no_members_is_done():
+    async def run():
+        pool = ReplicaPool(["http://127.0.0.1:1"], health_interval_s=30.0)
+        ctl = RolloutController(
+            pool, members=[], spawner=lambda: None, version_to="v2"
+        )
+        assert await ctl.run() == DONE
+        await pool.stop()
+
+    asyncio.run(run())
+
+
+def test_rollout_prom_counter_labels():
+    from spotter_tpu.obs import prom
+
+    text = prom.render(
+        {"rollout": {"rollouts_total": {"promoted": 1, "rolled_back": 2}}}
+    )
+    assert (
+        'spotter_tpu_rollout_rollouts_total{verdict="promoted"} 1' in text
+    )
+    assert (
+        'spotter_tpu_rollout_rollouts_total{verdict="rolled_back"} 2'
+        in text
+    )
+
+
+# ---------------------------------------------------------------------------
+# the deployment chaos drills (the acceptance surface)
+
+
+def _run_row(name: str) -> dict:
+    sc = next(s for s in DEPLOY_MATRIX if s.name == name)
+    report = asyncio.run(run_deploy_scenario(sc))
+    assert report["ok"], json.dumps(
+        {k: v for k, v in report.items() if k != "replica_snapshots"},
+        indent=2,
+        default=str,
+    )
+    return report
+
+
+def test_deploy_good_rolls_everyone():
+    report = _run_row("good-deploy")
+    assert report["state"] == "done"
+    assert report["fleet_versions"] == ["v2", "v2", "v2"]
+    assert report["client_failures"] == 0
+    assert report["rollouts_total"] == {"promoted": 1, "rolled_back": 0}
+
+
+def test_deploy_slow_canary_rolls_back_on_p99():
+    report = _run_row("bad-deploy-slow")
+    assert report["reason"] == "p99_vs_baseline"
+    assert report["client_failures"] == 0
+    assert report["trace_pinned"]
+    # the old fleet is intact after the rollback
+    assert report["fleet_size"] == 3
+    assert all(v == "v1" for v in report["fleet_versions"])
+
+
+def test_deploy_flaky_canary_rolls_back_on_error_rate():
+    report = _run_row("bad-deploy-flaky")
+    assert report["reason"] == "error_rate"
+    assert report["client_failures"] == 0
+
+
+def test_deploy_corrupt_canary_rolls_back_via_crc():
+    report = _run_row("bad-deploy-corrupt")
+    assert report["reason"] == "error_rate"
+    assert report["invalid_responses"] > 0
+    assert report["client_failures"] == 0
+
+
+def test_deploy_wrong_output_caught_by_shadow_lane():
+    report = _run_row("bad-deploy-wrong-output")
+    assert report["reason"] == "shadow_diff"
+    assert report["shadow"]["diffs_total"] >= 2
+    # shadow traffic is never client-visible: zero failures even though
+    # the canary answered garbage the whole time
+    assert report["client_failures"] == 0
+
+
+def test_spawn_timeout_rolls_back():
+    """A canary that never turns ready must roll back (spawn_timeout),
+    not hang the rollout."""
+
+    class DeadHandle:
+        url = "http://127.0.0.1:1"  # reserved port: never healthy
+        version = "v2"
+
+        def shutdown(self) -> None:
+            pass
+
+    async def run():
+        det, server, url = await _stub_server(version="v1")
+        pool = ReplicaPool([url], health_interval_s=0.05)
+        ctl = RolloutController(
+            pool,
+            members=[url],
+            spawner=lambda: DeadHandle(),
+            version_to="v2",
+            spawn_wait_s=0.5,
+            tick_s=0.05,
+        )
+        state = await asyncio.wait_for(ctl.run(), timeout=10.0)
+        assert state == "rolled_back"
+        assert ctl.rollback_reason == "spawn_timeout"
+        # the old member still serves
+        assert pool.replica_for(url) is not None
+        await pool.stop()
+        await server.close()
+        await det.aclose()
+
+    asyncio.run(run())
