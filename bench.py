@@ -2336,6 +2336,315 @@ def rollout_drill_bench(args) -> int:
     return 0 if passed else 1
 
 
+def controller_crash_bench(args) -> int:
+    """Crash-safe control plane, measured (ISSUE 16 acceptance): REAL
+    controller processes (`python -m spotter_tpu.serving.reconcile`) over
+    REAL supervised stub replicas, kill -9'd / corrupted / fenced at
+    deterministic points. Four drill rows:
+
+    1. **Crash mid-rollout under load**: the leader is SIGKILLed the
+       moment its journal says `canary`; the successor must adopt every
+       live member from the endpoints manifest (0 double-spawns), serve
+       out the REMAINING verdict window, and finish the rollout — while
+       closed-loop client traffic runs against the serve pool the whole
+       time. Gates: all scenario invariants, 0 client-visible failures,
+       reconverge <= --ctrl-converge-gate-s.
+    2. **Crash mid-preemption-storm under load**: preempt markers
+       written, children exiting 83, THEN kill -9 — the successor adopts
+       all spot+serve supervisors, clears the stale markers, and
+       reconverges with the serve pool never dropping a client request.
+    3. **Journal corrupt + crash**: a flipped journal byte must FAIL the
+       CRC on the successor's load (detected, never silently replayed),
+       count exactly one rebuild-from-observation, and reconverge.
+    4. **Stale-leader fencing**: SIGSTOP the leader past its lease TTL;
+       the standby takes over at a strictly higher epoch; the old
+       leader's next actuation is refused by the fencing check and it
+       demotes itself without ever touching the fleet.
+
+    Prints ONE JSON line accepted by tools/bench_compare.py; exits
+    non-zero when any gate fails.
+    """
+    import asyncio
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from spotter_tpu.testing.chaos_matrix import (
+        CONTROLLER_MATRIX,
+        ControllerScenario,
+        run_controller_scenario,
+    )
+
+    class ManifestLoad:
+        """Closed-loop client load over a scenario's live serve members,
+        run from a background thread with its own event loop. Membership
+        is synced from the endpoints manifest every 0.2 s — exactly what
+        an edge router watching the manifest would do — so the load
+        follows the fleet through waves, retires, and adoption. The pool's
+        replay-on-failure masks drained members; anything that still
+        surfaces counts as a client-visible failure (the zero gate)."""
+
+        def __init__(self, manifest_path: str, concurrency: int) -> None:
+            self.manifest_path = manifest_path
+            self.concurrency = concurrency
+            self.ok = 0
+            self.failures = 0
+            self.errors: list = []
+            self._stop = threading.Event()
+            self._thread = None
+
+        def start(self) -> None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+        def stop(self) -> None:
+            self._stop.set()
+            t, self._thread = self._thread, None
+            if t is not None:
+                t.join(timeout=15.0)
+
+        def stats(self) -> dict:
+            return {
+                "requests": self.ok + self.failures,
+                "ok": self.ok,
+                "failures": self.failures,
+                "errors": self.errors[:5],
+            }
+
+        def _run(self) -> None:
+            asyncio.run(self._loop())
+
+        async def _loop(self) -> None:
+            from spotter_tpu.serving.replica_pool import ReplicaPool
+            from spotter_tpu.serving.statestore import EndpointsManifest
+
+            manifest = EndpointsManifest(self.manifest_path)
+            pool = ReplicaPool(
+                [],
+                allow_empty=True,
+                health_interval_s=0.1,
+                request_timeout_s=5.0,
+                # same rationale as the rollout drill: at 20 ms stub
+                # service the outlier scorer only sees scheduler jitter
+                outlier_ratio=0.0,
+            )
+
+            def sync() -> None:
+                # the manifest is keyed by member url
+                serve = {
+                    url.rstrip("/")
+                    for url, e in manifest.entries().items()
+                    if e.get("pool") == "serve"
+                }
+                have = {r.url for r in pool.replicas}
+                for url in serve - have:
+                    pool.add_endpoint(url, healthy=False)
+                for url in have - serve:
+                    pool.remove_endpoint(url)
+
+            sync()
+            await pool.start()
+
+            async def worker() -> None:
+                while not self._stop.is_set():
+                    if not pool.has_available():
+                        await asyncio.sleep(0.02)
+                        continue
+                    try:
+                        await pool.detect(
+                            {"image_urls": ["http://example.com/room.jpg"]}
+                        )
+                        self.ok += 1
+                    except Exception as exc:
+                        self.failures += 1
+                        if len(self.errors) < 5:
+                            self.errors.append(
+                                f"{type(exc).__name__}: {exc}"
+                            )
+
+            async def syncer() -> None:
+                while not self._stop.is_set():
+                    sync()
+                    await asyncio.sleep(0.2)
+
+            tasks = [asyncio.create_task(syncer())] + [
+                asyncio.create_task(worker())
+                for _ in range(self.concurrency)
+            ]
+            while not self._stop.is_set():
+                await asyncio.sleep(0.05)
+            # workers poll the stop flag each iteration; a request already
+            # in flight is bounded by the pool's 5 s timeout
+            _, pending = await asyncio.wait(tasks, timeout=12.0)
+            for t in pending:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await pool.stop()
+
+    gate_s = args.ctrl_converge_gate_s
+    by_name = {sc.name: sc for sc in CONTROLLER_MATRIX}
+    rollout_sc = by_name["crash-mid-rollout-resume"]
+    corrupt_sc = by_name["journal-corrupt-rebuild"]
+    fencing_sc = by_name["stale-leader-fencing"]
+    storm_sc = ControllerScenario(
+        # the committed crash-mid-storm row, widened to the bench fleet
+        # and given a serve pool so client load has someone to talk to
+        name="crash-mid-storm-under-load",
+        spot_size=args.ctrl_spot,
+        serve_size=args.ctrl_serve,
+        converge_timeout_s=gate_s,
+        invariants={
+            "adoptions": args.ctrl_spot + args.ctrl_serve,
+            "adopted_all": True,
+            "spawns": 0,
+            "journal_rebuilds": 0,
+            "converged": True,
+        },
+    )
+
+    workdir = tempfile.mkdtemp(prefix="ctrl-drill-")
+    rows: dict = {}
+    try:
+        for sc, with_load in (
+            (rollout_sc, True),
+            (storm_sc, True),
+            (corrupt_sc, False),
+            (fencing_sc, False),
+        ):
+            print(f"# controller-crash: running {sc.name} ...",
+                  file=sys.stderr)
+            if with_load:
+                load = ManifestLoad(
+                    os.path.join(workdir, sc.name, "endpoints.json"),
+                    args.ctrl_concurrency,
+                )
+                try:
+                    report = run_controller_scenario(
+                        sc, workdir,
+                        on_ready=load.start, on_converged=load.stop,
+                    )
+                finally:
+                    load.stop()
+                report["client"] = load.stats()
+            else:
+                report = run_controller_scenario(sc, workdir)
+            rows[sc.name] = report
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    rollout = rows["crash-mid-rollout-resume"]
+    storm = rows["crash-mid-storm-under-load"]
+    corrupt = rows["journal-corrupt-rebuild"]
+    fencing = rows["stale-leader-fencing"]
+
+    def _rec(report: dict) -> dict:
+        return (report.get("successor") or {}).get("reconcile") or {}
+
+    gates = {
+        "rollout_resumed_and_done": rollout["ok"],
+        "rollout_zero_client_failures": (
+            rollout["client"]["failures"] == 0
+            and rollout["client"]["ok"] > 0
+        ),
+        "rollout_converge_within_gate": (
+            rollout.get("converge_s") is not None
+            and rollout["converge_s"] <= gate_s
+        ),
+        "storm_adopted_all_no_double_spawn": storm["ok"],
+        "storm_zero_client_failures": (
+            storm["client"]["failures"] == 0
+            and storm["client"]["ok"] > 0
+        ),
+        "storm_converge_within_gate": (
+            storm.get("converge_s") is not None
+            and storm["converge_s"] <= gate_s
+        ),
+        "corrupt_journal_detected_and_rebuilt": corrupt["ok"],
+        "stale_leader_fenced": fencing["ok"],
+    }
+    passed = all(gates.values())
+    old = fencing.get("old_leader") or {}
+    print(
+        f"# controller-crash: kill -9 mid-canary -> successor adopted "
+        f"{_rec(rollout).get('adoptions_total')}/"
+        f"{rollout.get('alive_at_takeover')} live members, resumed the "
+        f"wave ({_rec(rollout).get('rollout_resumes_total')} resume, "
+        f"{_rec(rollout).get('spawns_total')} spawn), rollout "
+        f"{rollout.get('successor', {}).get('rollout_result')} in "
+        f"{rollout.get('converge_s', float('nan')):.2f} s under "
+        f"{rollout['client']['requests']} client reqs "
+        f"({rollout['client']['failures']} failures); storm row adopted "
+        f"{_rec(storm).get('adoptions_total')}/"
+        f"{storm.get('alive_at_takeover')} in "
+        f"{storm.get('converge_s', float('nan')):.2f} s "
+        f"({storm['client']['failures']} failures / "
+        f"{storm['client']['requests']} reqs); corrupt journal -> "
+        f"{_rec(corrupt).get('journal_rebuilds_total')} CRC-detected "
+        f"rebuild; stale leader fenced at epoch "
+        f"{old.get('epoch')} < {fencing.get('successor', {}).get('epoch')} "
+        f"({(old.get('reconcile') or {}).get('fencing_rejections_total')} "
+        f"rejections)",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"controller-crash drill: kill -9 the active controller "
+            f"mid-rollout and mid-preemption-storm over real supervised "
+            f"stub fleets ({args.ctrl_spot} spot + {args.ctrl_serve} "
+            f"serve); gates: successor adopts all live members with 0 "
+            f"double-spawns, resumes/finishes the in-flight wave, "
+            f"reconverges <= {gate_s:.0f} s, 0 client-visible failures "
+            f"under load, corrupt journal CRC-detected + 1 rebuild, "
+            f"stale leader refused by fencing epoch"
+        ),
+        "value": round(float(rollout.get("converge_s") or -1.0), 3),
+        "unit": "seconds",
+        "vs_baseline": None,
+        "rollout_converge_s": round(
+            float(rollout.get("converge_s") or -1.0), 3
+        ),
+        "rollout_result": rollout.get("successor", {}).get(
+            "rollout_result"
+        ),
+        "rollout_resumes": _rec(rollout).get("rollout_resumes_total"),
+        "rollout_adoptions": _rec(rollout).get("adoptions_total"),
+        "rollout_alive_at_takeover": rollout.get("alive_at_takeover"),
+        "rollout_spawns": _rec(rollout).get("spawns_total"),
+        "rollout_serve_versions": rollout.get("serve_versions"),
+        "rollout_client": rollout["client"],
+        "rollout_checks": rollout["checks"],
+        "storm_converge_s": round(
+            float(storm.get("converge_s") or -1.0), 3
+        ),
+        "storm_stormed": storm.get("stormed"),
+        "storm_adoptions": _rec(storm).get("adoptions_total"),
+        "storm_alive_at_takeover": storm.get("alive_at_takeover"),
+        "storm_spawns": _rec(storm).get("spawns_total"),
+        "storm_client": storm["client"],
+        "storm_checks": storm["checks"],
+        "corrupt_first_exit": corrupt.get("first_exit"),
+        "corrupt_journal_rebuilds": _rec(corrupt).get(
+            "journal_rebuilds_total"
+        ),
+        "corrupt_adoptions": _rec(corrupt).get("adoptions_total"),
+        "corrupt_checks": corrupt["checks"],
+        "fencing_old_epoch": old.get("epoch"),
+        "fencing_successor_epoch": fencing.get("successor", {}).get(
+            "epoch"
+        ),
+        "fencing_rejections": (old.get("reconcile") or {}).get(
+            "fencing_rejections_total"
+        ),
+        "fencing_old_phase": old.get("phase"),
+        "fencing_checks": fencing["checks"],
+        "gates": gates,
+        "pass": passed,
+    }
+    print(json.dumps(result))
+    return 0 if passed else 1
+
+
 def cache_bench(args) -> int:
     """Caching tier, measured not asserted (ISSUE 5 + ISSUE 11): the REAL
     detector + MicroBatcher + result-cache/coalescing plumbing under a
@@ -3618,6 +3927,31 @@ def main() -> int:
     parser.add_argument("--rollout-overhead-requests", type=int, default=40)
     parser.add_argument("--rollout-overhead-rounds", type=int, default=8)
     parser.add_argument(
+        "--controller-crash",
+        action="store_true",
+        help="run the crash-safe control-plane drill instead (CPU ok, "
+        "model-free, real controller subprocesses): kill -9 the leader "
+        "mid-rollout and mid-preemption-storm under client load (gates: "
+        "adopt all live members, 0 double-spawns, resume the wave, "
+        "reconverge in-gate, 0 client failures), corrupt-journal CRC "
+        "detection + rebuild, and stale-leader fencing; exits non-zero "
+        "when any gate fails",
+    )
+    parser.add_argument(
+        "--ctrl-spot", type=int, default=3,
+        help="spot-pool size for the storm-under-load row",
+    )
+    parser.add_argument(
+        "--ctrl-serve", type=int, default=2,
+        help="serve-pool size (the members client load talks to)",
+    )
+    parser.add_argument("--ctrl-concurrency", type=int, default=4)
+    parser.add_argument(
+        "--ctrl-converge-gate-s", type=float, default=15.0,
+        help="successor must reconverge desired==observed within this "
+        "(the ISSUE 16 acceptance bound)",
+    )
+    parser.add_argument(
         "--tp",
         action="store_true",
         help="run the tensor-parallel serving bench instead (CPU ok over "
@@ -3680,6 +4014,8 @@ def main() -> int:
         return gray_storm_bench(args)
     if args.rollout_drill:
         return rollout_drill_bench(args)
+    if args.controller_crash:
+        return controller_crash_bench(args)
     if args.failover:
         return failover_bench(args)
     if args.preemption_storm:

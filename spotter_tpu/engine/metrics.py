@@ -79,6 +79,50 @@ def default_weights_digest() -> str | None:
     return os.environ.get(WEIGHTS_DIGEST_ENV, "").strip() or None
 
 
+class ControlPlaneMetrics:
+    """Counters for the crash-safe control plane (ISSUE 16): how often the
+    reconcile loop ran, what it adopted instead of double-spawning, what
+    fencing refused, and how far observed capacity sits from desired.
+
+    Single-threaded by design (the reconciler is event-loop-confined like
+    the fleet controller), so these are plain ints — no locks. `drift` is
+    the prom-labeled gauge ({pool: desired - ready}); `drift_detail`
+    carries the desired/ready split for /healthz and fleet_top."""
+
+    def __init__(self) -> None:
+        self.reconcile_loops_total = 0
+        self.adoptions_total = 0
+        self.fencing_rejections_total = 0
+        self.journal_rebuilds_total = 0
+        self.manifest_pruned_total = 0
+        self.spawns_total = 0
+        self.rollout_resumes_total = 0
+        self.drift: dict[str, int] = {}
+        self.drift_detail: dict[str, dict] = {}
+
+    def set_drift(self, drift: dict, detail: dict | None = None) -> None:
+        self.drift = dict(drift)
+        if detail is not None:
+            self.drift_detail = detail
+
+    def snapshot(self) -> dict:
+        return {
+            "reconcile_loops_total": self.reconcile_loops_total,
+            "adoptions_total": self.adoptions_total,
+            "fencing_rejections_total": self.fencing_rejections_total,
+            "journal_rebuilds_total": self.journal_rebuilds_total,
+            "manifest_pruned_total": self.manifest_pruned_total,
+            "spawns_total": self.spawns_total,
+            "rollout_resumes_total": self.rollout_resumes_total,
+            "drift": dict(self.drift),
+            "drift_detail": {
+                k: dict(v) for k, v in self.drift_detail.items()
+            },
+            "drift_total": sum(abs(v) for v in self.drift.values()),
+            "converged": all(v == 0 for v in self.drift.values()),
+        }
+
+
 class Metrics:
     def __init__(self, window: int = 2048) -> None:
         self._lock = threading.Lock()

@@ -38,6 +38,8 @@ _LABELED_KEYS = {
     "hbm_per_device": ("device", "stat"),
     # deployment plane (ISSUE 15): one counter per rollout outcome
     "rollouts_total": ("verdict",),
+    # control plane (ISSUE 16): desired-vs-observed gap per pool
+    "drift": ("pool",),
 }
 # keys whose dict values are {"p50": x, "p90": y, ...} quantile summaries
 # (the engine snapshot's slack_at_dispatch_ms, ISSUE 9) — rendered as a

@@ -66,6 +66,23 @@ def multihost_env_summary() -> dict:
     }
 
 
+def _enable_cpu_collectives() -> None:
+    """On the CPU backend, multi-process computations need a CPU collectives
+    implementation (jax >= 0.4.34 ships gloo but defaults to "none", which
+    fails any cross-process jit with "Multiprocess computations aren't
+    implemented on the CPU backend"). The 2-process CPU dryruns — the
+    driver-gate stand-in for a DCN slice (tests/test_multihost.py) — hit
+    exactly that, so arm gloo before distributed init when we're on CPU.
+    Must run before the backend initializes; a no-op on TPU or when the jax
+    version predates the option."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # option absent in this jax, or backend already up
+        logger.debug("could not arm gloo CPU collectives", exc_info=True)
+
+
 def initialize_multihost(force: bool = False) -> bool:
     """Join the jax.distributed cluster if the TPU_WORKER_* env says we're in one.
 
@@ -89,6 +106,7 @@ def initialize_multihost(force: bool = False) -> bool:
     coordinator = f"{hosts[0]}:{env['SPOTTER_COORDINATOR_PORT']}"
     if _distributed_is_initialized():  # already up
         return True
+    _enable_cpu_collectives()
     timeout_s = coordinator_timeout_s()
     logger.info(
         "multihost init: coordinator=%s num_processes=%d process_id=%s "

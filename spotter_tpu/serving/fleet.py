@@ -305,6 +305,11 @@ class FleetController:
         self.storms_total = 0
         self.class_requests = {SLO: 0, BULK: 0}
         self.class_failures = {SLO: 0, BULK: 0}
+        # leader fencing hook (ISSUE 16): when set (serving/reconcile.py
+        # installs `Reconciler.fence`), every spawn re-checks leadership
+        # and raises statestore.StaleLeaderError for a deposed controller
+        # — stale actuations are refused at the boundary, not logged after
+        self.fence: Optional[Callable[[], object]] = None
 
     # ---- lifecycle ----
 
@@ -350,9 +355,70 @@ class FleetController:
         fp.members.append(_Member(handle.url, handle))
 
     def _spawn(self, fp: FleetPool) -> None:
+        if self.fence is not None:
+            self.fence()  # StaleLeaderError for a deposed controller
         handle = fp.spec.spawner()
         self._adopt(fp, handle)
         logger.info("pool %s: spawned member %s", fp.spec.name, handle.url)
+
+    # ---- reconciler surface (ISSUE 16) ----
+
+    def adopt_endpoint(
+        self, pool_name: str, handle: MemberHandle,
+        version: Optional[str] = None,
+    ) -> bool:
+        """Adopt an already-running member (orphan adoption: the reconcile
+        loop found it in the endpoints manifest after a controller
+        restart). Idempotent per URL — re-adoption of a known member is a
+        no-op, which is what makes restart free of double-spawns."""
+        fp = self.pools.get(pool_name)
+        if fp is None or fp.member_for(handle.url) is not None:
+            return False
+        self._adopt(fp, handle)
+        if version:
+            fp.pool.set_version(handle.url, version)
+        logger.info("pool %s: adopted member %s", pool_name, handle.url)
+        return True
+
+    async def set_target_size(self, pool_name: str, n: int) -> None:
+        """Apply a journaled desired size. Growth is satisfied by
+        `ensure_population` on the next reconcile step; shrink retires the
+        newest members past the target (remove from routing first, then
+        shut down — the scale-to-zero discipline, per member)."""
+        fp = self.pools[pool_name]
+        fp.spec.target_size = max(int(n), 0)
+        excess = list(fp.members)[fp.spec.target_size:]
+        if not excess:
+            return
+        for m in excess:
+            fp.pool.remove_endpoint(m.url)
+            fp.members.remove(m)
+        logger.info(
+            "pool %s: shrunk to target %d (%d members retired)",
+            pool_name, fp.spec.target_size, len(excess),
+        )
+        loop = asyncio.get_running_loop()
+        waits = [
+            loop.run_in_executor(None, m.handle.shutdown)
+            for m in excess
+            if m.handle is not None
+        ]
+        if waits:
+            await asyncio.gather(*waits, return_exceptions=True)
+
+    def ensure_population(self, pool_name: str) -> int:
+        """Spawn up to the desired size, counting members a retire already
+        scheduled for jittered respawn — the reconcile loop's convergence
+        step must not race the controller's own backoff machinery into
+        double-spawning."""
+        fp = self.pools.get(pool_name)
+        if fp is None or fp.spec.spawner is None or fp.scaled_to_zero:
+            return 0
+        spawned = 0
+        while len(fp.members) + len(fp._respawn_due) < fp.spec.target_size:
+            self._spawn(fp)
+            spawned += 1
+        return spawned
 
     # ---- routing ----
 
@@ -668,6 +734,7 @@ def fleet_member_urls(controller: FleetController) -> list[str]:
 def make_fleet_app(
     controller: FleetController, limiter=None,
     aggregator: FleetAggregator | None = None,
+    reconciler=None,
 ) -> web.Application:
     """The fleet edge: /detect classifies (header/payload) and routes
     through the controller; /metrics serves the pool gauges the storm bench
@@ -678,7 +745,11 @@ def make_fleet_app(
     shedding bulk before slo when the limit is hit. `aggregator` (default:
     built over every pool's members from `SPOTTER_TPU_FLEET_SCRAPE_S`; 0
     disables) is the ISSUE 12 fleet telemetry plane — the merged `fleet`
-    /metrics block, /debug/fleet, and /debug/traces?fleet=1 stitching."""
+    /metrics block, /debug/fleet, and /debug/traces?fleet=1 stitching.
+    `reconciler` (ISSUE 16, default None) attaches a
+    `reconcile.Reconciler`: /healthz grows the leadership + drift block
+    and /metrics the `reconcile` counters (adoptions, fencing rejections,
+    journal rebuilds, per-pool drift)."""
     if aggregator is None:
         aggregator = FleetAggregator(lambda: fleet_member_urls(controller))
     app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -766,8 +837,14 @@ def make_fleet_app(
             name: fp.pool.has_available()
             for name, fp in controller.pools.items()
         }
+        body: dict = {"pools_available": available}
+        if reconciler is not None:
+            # control-plane block (ISSUE 16): leadership + per-pool drift
+            from spotter_tpu.serving.reconcile import healthz_block
+
+            body.update(healthz_block(reconciler))
         return web.json_response(
-            {"pools_available": available},
+            body,
             status=200 if any(available.values()) else 503,
         )
 
@@ -787,6 +864,10 @@ def make_fleet_app(
         # ROADMAP item 2
         if aggregator.enabled:
             snap["fleet"] = aggregator.fleet_snapshot()
+        # crash-safe control plane (ISSUE 16): reconcile loop counters +
+        # the desired-vs-ready drift gauge, labeled per pool by prom
+        if reconciler is not None:
+            snap["reconcile"] = reconciler.snapshot()
         return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)

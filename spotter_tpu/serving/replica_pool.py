@@ -505,6 +505,13 @@ class ReplicaPool:
         except Exception as exc:
             ok = False
             r.last_error = f"health: {exc!r}"
+        if self.replica_for(r.url) is not r:
+            # the member was retired (remove_endpoint) — or removed and
+            # re-added as a NEW Replica object — while this probe was in
+            # flight (ISSUE 16 satellite): mutating the stale object now
+            # would resurrect a retiring member into the ring mid-drain,
+            # exactly the adoption/retire race the reconcile loop surfaced
+            return
         if not ok:
             r.healthy = False
             return

@@ -249,6 +249,50 @@ class ShadowLane:
         }
 
 
+def resume_plan(record, now: Optional[float] = None) -> Optional[dict]:
+    """What a restarted controller should do about a journaled rollout
+    (ISSUE 16): None when there is nothing in flight (no record, or a
+    terminal state); otherwise a directive dict:
+
+    - `{"action": "resume", ...}` — the crash landed inside a live canary
+      window: re-adopt the canary at `canary_url` and serve out the
+      REMAINING `window_s`;
+    - `{"action": "rollback", ...}` — the canary window expired while no
+      controller was alive to judge it (the canary carried live weight
+      unwatched), so the only safe move is rollback;
+    - `{"action": "restart_wave", ...}` — the crash landed between waves
+      (spawning/promoting): start the wave over; orphan adoption has
+      already reclaimed any half-spawned canary via the manifest.
+
+    Wall-clock (`time.time`) on purpose: the journal outlives the process
+    whose monotonic clock stamped it."""
+    if not isinstance(record, dict):
+        return None
+    state = record.get("state")
+    if state not in (SPAWNING, CANARY, PROMOTING):
+        return None
+    now = time.time() if now is None else now
+    plan = {
+        "wave": int(record.get("wave") or 0),
+        "version_to": record.get("version_to") or "",
+        "version_from": record.get("version_from") or "",
+        "canary_url": record.get("canary_url"),
+        "old_urls": list(record.get("old_urls") or []),
+    }
+    if state == CANARY and record.get("canary_url"):
+        remaining = float(record.get("window_deadline") or 0.0) - now
+        if remaining <= 0:
+            plan["action"] = "rollback"
+            plan["reason"] = "verdict_window_expired"
+        else:
+            plan["action"] = "resume"
+            plan["window_s"] = remaining
+        return plan
+    plan["action"] = "restart_wave"
+    plan["canary_url"] = None  # not yet serving at weight; respawn/adopt
+    return plan
+
+
 class RolloutController:
     """Wave-by-wave versioned rollout over a live `ReplicaPool`.
 
@@ -279,6 +323,9 @@ class RolloutController:
         drain_deadline_ms: Optional[float] = None,
         spawn_wait_s: Optional[float] = None,
         tick_s: float = 0.1,
+        store=None,
+        resume: Optional[dict] = None,
+        resume_handle=None,
     ) -> None:
         self.pool = pool
         self.old_members = [
@@ -342,9 +389,19 @@ class RolloutController:
             else _env_float(SPAWN_WAIT_ENV, DEFAULT_SPAWN_WAIT_S)
         )
         self.tick_s = tick_s
+        # durable intent (ISSUE 16): every wave transition is journaled to
+        # the statestore BEFORE the fleet mutation it describes, so a
+        # controller killed mid-wave leaves enough recorded state for its
+        # successor to resume the wave (or roll back an expired one) —
+        # `resume` is that successor's directive (see `resume_plan`), and
+        # `resume_handle` re-attaches the orphaned canary's member handle
+        # (a reconcile.ManifestHandle) so retire/shutdown still work.
+        self.store = store
+        self._resume = resume
+        self._resume_handle = resume_handle
         # state
         self.state = IDLE
-        self.wave = 0
+        self.wave = int(resume.get("wave") or 0) if resume else 0
         self.canary: Optional[RolloutMember] = None
         self.canary_since: Optional[float] = None
         self.rollback_reason: Optional[str] = None
@@ -402,8 +459,25 @@ class RolloutController:
         "rolled_back"). One wave per old member; the first wave is the
         canary wave (full verdict window), later waves confirm on the
         shorter window."""
-        if not self.old_members:
+        if self._resume is not None and self._resume.get("expired"):
+            # crashed mid-window and the verdict window expired while no
+            # controller was alive to judge it: the canary got live weight
+            # with nobody watching, so the ONLY safe resume is rollback
+            url = self._resume.get("canary_url")
+            if url:
+                self.canary = RolloutMember(
+                    url=url, handle=self._resume_handle,
+                    version=self.version_to,
+                )
+                if self.pool.replica_for(url) is None:
+                    self.pool.add_endpoint(url, healthy=False)
+            await self._rollback("verdict_window_expired")
+            return self.state
+        if not self.old_members and not (
+            self._resume and self._resume.get("canary_url")
+        ):
             self.state = DONE
+            self._journal(DONE)
             return self.state
         logger.info(
             "rollout %s -> %s: %d members, canary weight %.0f%%, "
@@ -412,17 +486,30 @@ class RolloutController:
             len(self.old_members), self.canary_weight * 100, self.window_s,
         )
         try:
-            while self.old_members:
+            first = True
+            while self.old_members or (
+                first and self._resume and self._resume.get("canary_url")
+            ):
+                resume_url = None
                 window = (
                     self.window_s if self.wave == 0 else self.confirm_window_s
                 )
-                ok, reason = await self._one_wave(window)
+                if first and self._resume is not None:
+                    resume_url = self._resume.get("canary_url")
+                    if resume_url and self._resume.get("window_s"):
+                        # serve out the REMAINDER of the journaled window,
+                        # not a fresh one — the dead controller's clock
+                        # still binds its successor
+                        window = float(self._resume["window_s"])
+                first = False
+                ok, reason = await self._one_wave(window, resume_url=resume_url)
                 if not ok:
                     await self._rollback(reason)
                     return self.state
                 self.wave += 1
                 self.waves_promoted_total += 1
             self.state = DONE
+            self._journal(DONE)
             self.rollouts_total["promoted"] += 1
             logger.info(
                 "rollout to %s complete: %d waves promoted",
@@ -432,15 +519,27 @@ class RolloutController:
         finally:
             await self._drain_shadow_tasks()
 
-    async def _one_wave(self, window_s: float) -> tuple[bool, str]:
+    async def _one_wave(
+        self, window_s: float, resume_url: Optional[str] = None
+    ) -> tuple[bool, str]:
         self.state = SPAWNING
-        handle = self.spawner()
-        if inspect.isawaitable(handle):
-            handle = await handle
-        url = handle.url.rstrip("/")
-        version = getattr(handle, "version", "") or self.version_to
+        if resume_url is None:
+            self._journal(SPAWNING)
+            handle = self.spawner()
+            if inspect.isawaitable(handle):
+                handle = await handle
+            url = handle.url.rstrip("/")
+            version = getattr(handle, "version", "") or self.version_to
+        else:
+            # resuming a journaled wave (ISSUE 16): the canary is already
+            # running (adopted from the endpoints manifest) — re-attach it
+            # instead of spawning a sibling
+            url = resume_url.rstrip("/")
+            handle = self._resume_handle
+            version = self.version_to
         self.canary = RolloutMember(url=url, handle=handle, version=version)
-        self.pool.add_endpoint(url, healthy=False)
+        if self.pool.replica_for(url) is None:
+            self.pool.add_endpoint(url, healthy=False)
         self.pool.set_version(url, version)
         self.pool.set_weight(url, self.canary_weight)
         # wait for the health loop to promote the new member
@@ -455,6 +554,11 @@ class RolloutController:
         self.state = CANARY
         self.canary_since = time.monotonic()
         self.verdict_window_s_used = window_s
+        # journal the canary phase with a WALL-CLOCK window deadline: a
+        # successor controller (new process, new monotonic epoch) must be
+        # able to decide "is this window still live" from the record alone
+        self._journal(CANARY, window_s=window_s,
+                      window_deadline=time.time() + window_s)
         r = self.pool.replica_for(url)
         base = {
             "requests": r.requests,
@@ -616,12 +720,18 @@ class RolloutController:
         assert self.canary is not None
         self.state = PROMOTING
         self.pool.set_weight(self.canary.url, None)  # full weight
-        old = self.old_members.pop(0)
+        # a resumed final wave can arrive with the retired cohort already
+        # empty (the predecessor promoted it before dying) — promote the
+        # canary, nothing left to retire
+        old = self.old_members.pop(0) if self.old_members else None
         logger.info(
             "rollout wave %d promoted: %s (%s) in, retiring %s",
-            self.wave, self.canary.url, self.canary.version, old.url,
+            self.wave, self.canary.url, self.canary.version,
+            old.url if old else "(nothing)",
         )
-        await self._retire(old)
+        self._journal(PROMOTING, promoted_url=self.canary.url)
+        if old is not None:
+            await self._retire(old)
         self.new_members.append(self.canary)
         self.canary = None
 
@@ -642,8 +752,32 @@ class RolloutController:
             r.pinned_weight = None
         self.rollback_s = time.monotonic() - t0
         self.state = ROLLED_BACK
+        self._journal(ROLLED_BACK, reason=reason)
         self.rollouts_total["rolled_back"] += 1
         self._pin_rollback_trace(reason)
+
+    def _journal(self, state: str, **extra) -> None:
+        """Record this transition in the durable statestore (ISSUE 16).
+        Best-effort by policy: a full state disk must degrade the rollout
+        to the pre-journal (memory-only) behavior, not abort a promotion
+        mid-flight — the chaos matrix covers the crash/resume paths where
+        the journal DID land."""
+        if self.store is None:
+            return
+        record = {
+            "state": state,
+            "wave": self.wave,
+            "version_to": self.version_to,
+            "version_from": self.version_from,
+            "canary_weight": self.canary_weight,
+            "canary_url": self.canary.url if self.canary else None,
+            "old_urls": [m.url for m in self.old_members],
+        }
+        record.update(extra)
+        try:
+            self.store.set_rollout(record)
+        except Exception:
+            logger.exception("journaling rollout state %r failed", state)
 
     def _pin_rollback_trace(self, reason: str) -> None:
         """Pin a synthetic flight-recorder trace (the brownout pattern):

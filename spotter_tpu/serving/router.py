@@ -72,6 +72,7 @@ from spotter_tpu.caching import keys
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.obs.aggregate import FleetAggregator
+from spotter_tpu.serving import reconcile as reconcile_mod
 from spotter_tpu.serving import wire
 from spotter_tpu.serving.fleet import (
     REQUEST_CLASS_HEADER,
@@ -142,6 +143,7 @@ def make_router_app(
     edge_negative_ttl_s: float | None = None,
     aggregator: FleetAggregator | None = None,
     rollout=None,
+    reconciler=None,
 ) -> web.Application:
     """`limiter` (default: `SPOTTER_TPU_ADMIT_EDGE_TARGET_MS` via
     `edge_limiter_from_env`, None = off) adds the ISSUE 8 AIMD edge gate:
@@ -159,7 +161,10 @@ def make_router_app(
     `rollout.RolloutController`: its shadow lane mirrors sampled /detect
     traffic to the canary (responses discarded, never client-visible) and
     its state/counters ride /metrics under `rollout` — idle cost is one
-    None/state check per request."""
+    None/state check per request. `reconciler` (ISSUE 16, default None)
+    attaches a `reconcile.Reconciler`: /healthz grows a `control_plane`
+    block (leadership + desired-vs-observed drift) and /metrics a
+    `reconcile` block (loop/adoption/fencing/rebuild counters)."""
     if affinity is None:
         affinity = affinity_from_env()
     if edge_negative_ttl_s is None:
@@ -544,6 +549,9 @@ def make_router_app(
                 # edge error-budget state (ISSUE 10): same block shape as
                 # the replica's /healthz slo_burn
                 "slo_burn": slo_burn.block(),
+                # control plane (ISSUE 16): leadership + fencing epoch +
+                # desired-vs-observed drift, same block the fleet app serves
+                **reconcile_mod.healthz_block(reconciler),
             },
             status=200 if available > 0 else 503,
         )
@@ -597,6 +605,10 @@ def make_router_app(
         # shadow-lane counters; prom renders rollouts_total{verdict=...}
         if rollout is not None:
             snap["rollout"] = rollout.snapshot()
+        # control plane (ISSUE 16): reconcile loop counters + drift gauge;
+        # prom renders reconcile_loops_total, drift{pool=...}, ...
+        if reconciler is not None:
+            snap["reconcile"] = reconciler.snapshot()
         return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)

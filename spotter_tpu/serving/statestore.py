@@ -1,0 +1,586 @@
+"""Durable control-plane state: CRC-framed journal + snapshot, leader
+lease, and the endpoints manifest (ISSUE 16 tentpole, parts a and d).
+
+PRs 1-15 made the data plane nearly unkillable, but the controllers that
+drive it (serving/fleet.py, serving/rollout.py) held every piece of fleet
+state in process memory: kill the controller mid-rollout and the canary is
+stranded at a pinned weight forever. This module is the durability layer
+under serving/reconcile.py: a desired-state spec that survives controller
+death, a lease that makes exactly one of N controllers act, and a manifest
+that lets a restarted controller *find* the replicas its predecessor
+spawned instead of double-spawning or orphan-killing them.
+
+Storage discipline — the SPTF frame-v2 rules (serving/wire.py), applied
+to files:
+
+- Every record on disk is framed `SPTS | ver | flags | payload_len |
+  payload_crc | header_crc` + canonical-JSON payload. The header checksum
+  covers the header fields, the payload checksum covers the bytes — so a
+  flip anywhere (header OR payload) fails a CRC, and a truncation anywhere
+  fails a length check. Corruption is *detected* (typed
+  `StateCorruptError`), never silently replayed: Spotlight's argument for
+  reconciling against observed capacity only works if the controller knows
+  when its recorded intent is untrustworthy.
+- The journal is append-only: one framed record per `append()`, flushed
+  and fsync'd before the call returns. Records carry a strictly
+  consecutive `seq`; a gap or regression is corruption (a lost or
+  reordered write), not a quirk.
+- Compaction writes the folded state as a single snapshot record to a
+  temp file, fsyncs, `os.replace()`s over the snapshot, then truncates the
+  journal the same way — the atomic-rename discipline every other
+  persistent artifact in this repo uses (supervisor pidfile, result cache
+  spill). A crash between the two replaces leaves snapshot(new) +
+  journal(old tail with seqs <= snapshot seq): load() skips already-folded
+  records by seq, so the overlap is harmless, not corrupt.
+
+Why kill -9 still resumes: SIGKILL can't tear a completed write() — the
+page cache outlives the process — so a controller killed mid-rollout
+leaves an intact journal and its successor resumes the wave. Only real
+damage (power loss mid-write, bit rot, an operator's stray dd) produces a
+bad CRC, and that is exactly when replaying intent would be dangerous —
+so the caller counts it and rebuilds from observation instead.
+
+Leader lease (part d): a JSON lease file guarded by flock on a sidecar
+lock. Acquisition increments a monotonic fencing epoch; every actuation
+the reconciler performs is stamped with the epoch it was planned under and
+re-checked (`LeaderLease.check()`) at the actuation boundary. A deposed
+controller — paused past its TTL, then resumed — fails the epoch check
+with `StaleLeaderError` before it can touch the fleet.
+"""
+
+import errno
+import fcntl
+import json
+import os
+import struct
+import time
+import zlib
+
+# ---- framing (SPTS = SPoTter State) ----
+
+STATE_MAGIC = b"SPTS"
+STATE_VERSION = 1
+
+# magic(4s) version(B) flags(B) payload_len(I) payload_crc(I) header_crc(I)
+_HEADER = struct.Struct(">4sBBIII")
+# header_crc covers everything before it
+_HEADER_CRC_SPAN = _HEADER.size - 4
+
+FLAG_SNAPSHOT = 0x01
+
+JOURNAL_NAME = "journal.sptj"
+SNAPSHOT_NAME = "snapshot.sptj"
+LEASE_NAME = "leader.lease"
+
+# Journals are small (a few KiB of intent); anything past this is damage,
+# not state — a corrupted length field must not trigger a giant read.
+MAX_PAYLOAD = 8 * 1024 * 1024
+
+
+class StateError(Exception):
+    """Base for control-plane state errors."""
+
+
+class StateCorruptError(StateError):
+    """The on-disk journal/snapshot failed a CRC, length, or sequence
+    check. The caller's contract: count it, rebuild desired state from
+    observation, never replay the damaged intent."""
+
+
+class StaleLeaderError(StateError):
+    """This controller's fencing epoch has been superseded — another
+    controller acquired the lease. Every actuation must refuse."""
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def encode_record(payload: dict, *, snapshot: bool = False) -> bytes:
+    """One framed state record: header (self-checksummed) + canonical
+    JSON. Canonical (sorted keys, tight separators) so identical state
+    always produces identical bytes — byte-diffable journals."""
+    body = json.dumps(
+        payload, sort_keys=True, separators=(",", ":")
+    ).encode("utf-8")
+    if len(body) > MAX_PAYLOAD:
+        raise StateError(f"state record too large ({len(body)} bytes)")
+    flags = FLAG_SNAPSHOT if snapshot else 0
+    head = _HEADER.pack(
+        STATE_MAGIC, STATE_VERSION, flags, len(body), _crc(body), 0
+    )
+    head = head[:_HEADER_CRC_SPAN] + struct.pack(
+        ">I", _crc(head[:_HEADER_CRC_SPAN])
+    )
+    return head + body
+
+
+def decode_records(blob: bytes, where: str) -> list[tuple[int, dict]]:
+    """All `(flags, payload)` records in a file image, validating every
+    byte; raises StateCorruptError on any truncation, flip, or garbage."""
+    records: list[tuple[int, dict]] = []
+    off = 0
+    n = len(blob)
+    while off < n:
+        if n - off < _HEADER.size:
+            raise StateCorruptError(
+                f"{where}: truncated header at offset {off} "
+                f"({n - off} of {_HEADER.size} bytes)"
+            )
+        head = blob[off:off + _HEADER.size]
+        magic, version, flags, plen, pcrc, hcrc = _HEADER.unpack(head)
+        if _crc(head[:_HEADER_CRC_SPAN]) != hcrc:
+            raise StateCorruptError(
+                f"{where}: header checksum mismatch at offset {off}"
+            )
+        if magic != STATE_MAGIC:
+            raise StateCorruptError(
+                f"{where}: bad magic {magic!r} at offset {off}"
+            )
+        if version != STATE_VERSION:
+            raise StateCorruptError(
+                f"{where}: unsupported state version {version} at "
+                f"offset {off}"
+            )
+        if plen > MAX_PAYLOAD:
+            raise StateCorruptError(
+                f"{where}: payload length {plen} exceeds cap at "
+                f"offset {off}"
+            )
+        start = off + _HEADER.size
+        if n - start < plen:
+            raise StateCorruptError(
+                f"{where}: truncated payload at offset {start} "
+                f"({n - start} of {plen} bytes)"
+            )
+        body = blob[start:start + plen]
+        if _crc(body) != pcrc:
+            raise StateCorruptError(
+                f"{where}: payload checksum mismatch at offset {start}"
+            )
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            # A payload that passes CRC but fails JSON means the *writer*
+            # was broken, which is just as untrustworthy.
+            raise StateCorruptError(
+                f"{where}: undecodable payload at offset {start}: {exc}"
+            ) from None
+        if not isinstance(payload, dict) or "seq" not in payload:
+            raise StateCorruptError(
+                f"{where}: record at offset {start} is not a "
+                "sequence-stamped object"
+            )
+        records.append((flags, payload))
+        off = start + plen
+    return records
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + os.replace — readers see old bytes or new bytes,
+    never a prefix."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# ---- desired-state store ----
+
+
+def _fold(state: dict, op: dict) -> None:
+    """Apply one journal op to the folded desired state, in place."""
+    kind = op.get("op")
+    if kind == "set_pool":
+        pool = dict(op.get("pool") or {})
+        name = op.get("name")
+        if not isinstance(name, str) or not name:
+            raise StateCorruptError("set_pool record without a pool name")
+        state["pools"][name] = pool
+    elif kind == "remove_pool":
+        state["pools"].pop(op.get("name"), None)
+    elif kind == "rollout":
+        state["rollout"] = op.get("rollout")
+    else:
+        raise StateCorruptError(f"unknown journal op {kind!r}")
+
+
+def empty_state() -> dict:
+    return {"pools": {}, "rollout": None}
+
+
+class StateStore:
+    """Durable desired-state spec: `{"pools": {name: {"size", "class",
+    "version", "canary_weight", ...}}, "rollout": {...}|None}`.
+
+    `load()` replays snapshot + journal strictly (any damage raises
+    StateCorruptError — the caller decides to rebuild). `append()` is the
+    only mutation path and fsyncs before returning, so an op that returned
+    survives kill -9. `compact()` folds the journal into the snapshot.
+    """
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.state = empty_state()
+        self.seq = 0  # last applied sequence number
+        self.journal_records = 0
+        self._journal_path = os.path.join(directory, JOURNAL_NAME)
+        self._snapshot_path = os.path.join(directory, SNAPSHOT_NAME)
+
+    # -- loading --
+
+    @classmethod
+    def load(cls, directory: str) -> "StateStore":
+        """Replay snapshot then journal. Raises StateCorruptError on ANY
+        damage; returns a store with `state`/`seq` reflecting the folded
+        intent otherwise (fresh empty state when neither file exists)."""
+        store = cls(directory)
+        snap_blob = _read_optional(store._snapshot_path)
+        if snap_blob:
+            recs = decode_records(snap_blob, SNAPSHOT_NAME)
+            if len(recs) != 1 or not (recs[0][0] & FLAG_SNAPSHOT):
+                raise StateCorruptError(
+                    f"{SNAPSHOT_NAME}: expected exactly one snapshot "
+                    f"record, found {len(recs)}"
+                )
+            payload = recs[0][1]
+            snap_state = payload.get("state")
+            if not isinstance(snap_state, dict) or not isinstance(
+                snap_state.get("pools"), dict
+            ):
+                raise StateCorruptError(
+                    f"{SNAPSHOT_NAME}: snapshot payload is not a state"
+                )
+            store.state = {
+                "pools": dict(snap_state["pools"]),
+                "rollout": snap_state.get("rollout"),
+            }
+            store.seq = int(payload["seq"])
+        journal_blob = _read_optional(store._journal_path)
+        if journal_blob:
+            for flags, op in decode_records(journal_blob, JOURNAL_NAME):
+                if flags & FLAG_SNAPSHOT:
+                    raise StateCorruptError(
+                        f"{JOURNAL_NAME}: snapshot record inside journal"
+                    )
+                seq = int(op["seq"])
+                if seq <= store.seq:
+                    # Tail already folded into the snapshot (crash between
+                    # compaction's two renames) — skip, don't re-apply.
+                    continue
+                if seq != store.seq + 1:
+                    raise StateCorruptError(
+                        f"{JOURNAL_NAME}: sequence gap ({store.seq} -> "
+                        f"{seq}) — a journal write was lost"
+                    )
+                _fold(store.state, op)
+                store.seq = seq
+                store.journal_records += 1
+        return store
+
+    @classmethod
+    def fresh(cls, directory: str) -> "StateStore":
+        """Discard any on-disk state and start empty — the
+        rebuild-from-observation path after StateCorruptError. The damaged
+        files are kept aside (`.corrupt`) for the post-mortem."""
+        store = cls(directory)
+        for name in (JOURNAL_NAME, SNAPSHOT_NAME):
+            path = os.path.join(directory, name)
+            if os.path.exists(path):
+                os.replace(path, path + ".corrupt")
+        return store
+
+    # -- mutation --
+
+    def append(self, op: str, **fields) -> int:
+        """Journal one op durably (fsync before return) and fold it into
+        the in-memory state. Returns the record's sequence number."""
+        seq = self.seq + 1
+        record = {"op": op, "seq": seq, **fields}
+        _fold(self.state, record)  # raises before any disk write if bad
+        frame = encode_record(record)
+        with open(self._journal_path, "ab") as f:
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        self.seq = seq
+        self.journal_records += 1
+        return seq
+
+    def set_pool(self, name: str, **spec) -> int:
+        """Desired pool spec: size, class ("spot"/"on_demand"), version,
+        canary_weight — merged over the existing spec."""
+        merged = dict(self.state["pools"].get(name) or {})
+        merged.update(spec)
+        return self.append("set_pool", name=name, pool=merged)
+
+    def remove_pool(self, name: str) -> int:
+        return self.append("remove_pool", name=name)
+
+    def set_rollout(self, rollout: dict | None) -> int:
+        """Record the in-flight rollout (or None when it finishes) — the
+        wave/state/deadline block RolloutController journals so a crash
+        mid-wave resumes (or expires into rollback)."""
+        return self.append("rollout", rollout=rollout)
+
+    def compact(self) -> None:
+        """Fold journal into snapshot: atomic snapshot rewrite, then
+        atomic journal truncation. Crash between the two leaves a
+        harmless already-folded journal tail (load() skips by seq)."""
+        payload = {"seq": self.seq, "state": self.state}
+        _atomic_write(
+            self._snapshot_path, encode_record(payload, snapshot=True)
+        )
+        _atomic_write(self._journal_path, b"")
+        self.journal_records = 0
+
+
+def _read_optional(path: str) -> bytes:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except FileNotFoundError:
+        return b""
+
+
+# ---- leader lease ----
+
+
+class LeaderLease:
+    """Active-passive leadership with a monotonic fencing epoch.
+
+    The lease is a JSON file `{"epoch": N, "owner": ..., "expires": T}`
+    rewritten atomically under flock (the flock serializes acquire /
+    heartbeat races between live processes; the epoch fences *dead or
+    paused* ones, which flock cannot). Wall-clock expiry is deliberate:
+    the TTL is seconds and the competing controllers share a host (or a
+    coherent clock), matching the single-host drill topology.
+
+    Usage: `try_acquire()` each reconcile tick — True means this process
+    leads for TTL from now and `epoch` is its fencing token. `check()` at
+    every actuation boundary re-reads the file and raises
+    StaleLeaderError when a higher epoch exists — the deposed-controller
+    path the chaos matrix drills.
+    """
+
+    def __init__(self, path: str, owner: str, ttl_s: float = 3.0):
+        self.path = path
+        self.owner = owner
+        self.ttl_s = ttl_s
+        self.epoch = 0  # our fencing epoch; 0 = never led
+        self.leading = False
+
+    def _read(self) -> dict:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                return {}
+            return data
+        except (OSError, json.JSONDecodeError):
+            # Unreadable lease = no lease; acquisition rewrites it. The
+            # lease is coordination, not state — safe to rebuild, unlike
+            # the journal.
+            return {}
+
+    def _locked(self):
+        lock_path = self.path + ".lock"
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except OSError:
+            os.close(fd)
+            raise
+        return fd
+
+    def try_acquire(self, now: float | None = None) -> bool:
+        """Acquire or renew leadership. Returns True when this process
+        holds the lease (epoch set), False when another live leader does.
+        Renewal keeps the epoch; taking over from an expired or absent
+        leader increments it (the fencing point)."""
+        now = time.time() if now is None else now
+        fd = self._locked()
+        try:
+            cur = self._read()
+            cur_epoch = int(cur.get("epoch") or 0)
+            expired = float(cur.get("expires") or 0.0) <= now
+            ours = (
+                cur.get("owner") == self.owner and cur_epoch == self.epoch
+            )
+            if ours and not expired:
+                self._write(cur_epoch, now)  # renew, same epoch
+                self.leading = True
+                return True
+            if not expired:
+                self.leading = False
+                return False
+            # Absent/expired: take over with a HIGHER epoch, even when the
+            # stale lease was our own — our pause may have let another
+            # controller act, so our old epoch must die with the pause.
+            self.epoch = cur_epoch + 1
+            self._write(self.epoch, now)
+            self.leading = True
+            return True
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def _write(self, epoch: int, now: float) -> None:
+        self.epoch = epoch
+        payload = json.dumps(
+            {
+                "epoch": epoch,
+                "owner": self.owner,
+                "expires": now + self.ttl_s,
+            },
+            sort_keys=True,
+        ).encode("utf-8")
+        _atomic_write(self.path, payload)
+
+    def check(self) -> int:
+        """Fencing check at the actuation boundary: re-read the lease and
+        raise StaleLeaderError when our epoch has been superseded (or we
+        never led). Returns the current epoch for stamping."""
+        if not self.leading or self.epoch <= 0:
+            raise StaleLeaderError(
+                f"{self.owner}: not the leader (epoch {self.epoch})"
+            )
+        cur = self._read()
+        cur_epoch = int(cur.get("epoch") or 0)
+        if cur_epoch != self.epoch or cur.get("owner") != self.owner:
+            self.leading = False
+            raise StaleLeaderError(
+                f"{self.owner}: fencing epoch {self.epoch} superseded "
+                f"by {cur_epoch} (owner {cur.get('owner')!r})"
+            )
+        return self.epoch
+
+    def release(self) -> None:
+        """Voluntary step-down (clean shutdown): expire our own lease so
+        the standby takes over immediately instead of waiting the TTL."""
+        if not self.leading:
+            return
+        fd = self._locked()
+        try:
+            cur = self._read()
+            if (
+                cur.get("owner") == self.owner
+                and int(cur.get("epoch") or 0) == self.epoch
+            ):
+                cur["expires"] = 0.0
+                _atomic_write(
+                    self.path,
+                    json.dumps(cur, sort_keys=True).encode("utf-8"),
+                )
+        finally:
+            self.leading = False
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+
+# ---- endpoints manifest ----
+
+
+class EndpointsManifest:
+    """Where a restarted controller finds its predecessor's replicas.
+
+    A JSON file `{"entries": {url: {pool, pidfile, preempt_file,
+    supervisor_pid, version}}}` updated read-modify-write under flock +
+    atomic rename. Supervisors register themselves at spawn and deregister
+    on PERMANENT exit (clean stop, bringup-failed, crash-loop) but stay
+    registered across preemption restarts — so the manifest stays accurate
+    while the controller is dead, which is the whole point: orphan
+    adoption reads it, probes each entry's /healthz identity block, and
+    adopts live members instead of double-spawning.
+
+    Entries are advisory, never trusted blindly: adoption verifies
+    liveness (supervisor pid + /healthz) before adopting and prunes
+    entries whose supervisor is gone. An unreadable manifest is treated
+    as empty (it is a cache of observations, rebuilt by the next spawn —
+    unlike the journal, there is no intent to mis-replay).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _mutate(self, fn) -> None:
+        lock_path = self.path + ".lock"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            entries = self.entries()
+            fn(entries)
+            payload = json.dumps(
+                {"entries": entries}, sort_keys=True
+            ).encode("utf-8")
+            _atomic_write(self.path, payload)
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def entries(self) -> dict:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {}
+        got = data.get("entries") if isinstance(data, dict) else None
+        return dict(got) if isinstance(got, dict) else {}
+
+    def add(self, url: str, **entry) -> None:
+        """Upsert: a supervisor restarting its child re-registers with a
+        fresh supervisor_pid; the url stays the stable key."""
+        def _add(entries):
+            merged = dict(entries.get(url) or {})
+            merged.update(entry)
+            entries[url] = merged
+        self._mutate(_add)
+
+    def remove(self, url: str) -> None:
+        def _remove(entries):
+            entries.pop(url, None)
+        self._mutate(_remove)
+
+
+def supervisor_alive(pid: int | None) -> bool:
+    """Is the supervising process still running? (signal-0 probe; EPERM
+    means alive-but-not-ours, which still counts as alive). A zombie —
+    exited but not yet reaped by ITS parent, which may be a test harness
+    that only reaps at teardown — still answers signal 0, but it serves
+    nothing and will never again: it counts as dead, so adoption skips it
+    and `ManifestHandle.shutdown` doesn't wait a full escalation timeout
+    for a process that already exited."""
+    if not pid or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError as exc:
+        return exc.errno == errno.EPERM
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        # field 3, after the parenthesised comm (which may contain spaces)
+        state = stat.rsplit(b")", 1)[-1].split()[0]
+        return state != b"Z"
+    except (OSError, IndexError):
+        return True  # no /proc (non-Linux): keep the signal-0 answer

@@ -35,6 +35,15 @@ Each (re)start exports `SPOTTER_TPU_RESTARTS=<n>` to the child so
 so harnesses (tests, bench.py --failover) can target the CURRENT child with
 preemption faults. SIGTERM to the supervisor forwards to the child and
 exits with the child's code — the pod-level preStop path stays intact.
+
+With `--manifest PATH --url URL` (ISSUE 16) the supervisor registers its
+replica in the shared endpoints manifest at startup and deregisters only
+on PERMANENT exit (clean stop, crash-loop circuit, SIGTERM) — it stays
+registered across preemption (83) and fatal-engine (85) restarts, because
+the replica identity survives them. That makes the manifest the control
+plane's observation of record: a restarted controller adopts every entry
+whose supervisor pid is still alive instead of double-spawning, and prunes
+entries whose supervisor died without the finally block running (kill -9).
 """
 
 import argparse
@@ -277,6 +286,11 @@ def main(argv: list[str] | None = None) -> int:
                         f"{BACKOFF_JITTER_ENV}, on unless set to 0)")
     parser.add_argument("--pidfile", default=None,
                         help="rewritten with the current child pid on every spawn")
+    parser.add_argument("--manifest", default=None,
+                        help="endpoints manifest (serving/statestore.py) to "
+                        "register this replica in for controller adoption")
+    parser.add_argument("--url", default=None,
+                        help="replica base URL recorded in --manifest")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="child command (after --)")
     args = parser.parse_args(argv)
@@ -285,6 +299,8 @@ def main(argv: list[str] | None = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         parser.error("no child command given (use -- CMD ARG...)")
+    if args.manifest and not args.url:
+        parser.error("--manifest requires --url (the manifest key)")
     logging.basicConfig(level=logging.INFO)
     sup = Supervisor(
         cmd,
@@ -297,7 +313,29 @@ def main(argv: list[str] | None = None) -> int:
         jitter=None if args.backoff_jitter is None
         else args.backoff_jitter == "on",
     )
-    return sup.run()
+    manifest = None
+    if args.manifest:
+        # stdlib-only import (no jax/httpx): keep supervisor bring-up light
+        from spotter_tpu.serving.statestore import EndpointsManifest
+
+        manifest = EndpointsManifest(args.manifest)
+        manifest.add(
+            args.url,
+            pool=os.environ.get("SPOTTER_TPU_POOL", ""),
+            version=os.environ.get("SPOTTER_TPU_BUILD_VERSION", ""),
+            preempt_file=os.environ.get("SPOTTER_TPU_PREEMPTION_FILE", ""),
+            pidfile=args.pidfile or "",
+            supervisor_pid=os.getpid(),
+        )
+    try:
+        return sup.run()
+    finally:
+        if manifest is not None:
+            # permanent exit only: preemption/fatal restarts never reach here
+            try:
+                manifest.remove(args.url)
+            except OSError:
+                pass  # best-effort — the reconciler prunes dead entries
 
 
 if __name__ == "__main__":

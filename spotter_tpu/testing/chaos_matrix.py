@@ -600,3 +600,501 @@ def run_deploy_matrix(
     for sc in scenarios if scenarios is not None else DEPLOY_MATRIX:
         reports.append(asyncio.run(run_deploy_scenario(sc)))
     return reports
+
+
+# ---------------------------------------------------------------------------
+# controller chaos drills (ISSUE 16)
+
+
+@dataclass
+class ControllerScenario:
+    """One crash-safe control-plane drill: REAL controller processes
+    (`python -m spotter_tpu.serving.reconcile`) over REAL supervised stub
+    replicas, killed/paused/corrupted at deterministic points.
+
+    Topology: an optional fleet-managed "spot" pool (the controller spawns
+    and maintains it from the journaled desired state) plus an optional
+    rollout-managed "serve" pool (`serve_size` v1 members the HARNESS
+    spawns — they register in the endpoints manifest, so any controller
+    finds them). The chaos point is either observed (`kill_at_rollout_state`:
+    SIGKILL the leader the moment its status file shows that rollout
+    state; `pause_leader`: SIGSTOP past the lease TTL, then SIGCONT) or
+    tick-deterministic (`faults`: a SPOTTER_TPU_FAULTS plan for the FIRST
+    controller — `controller_crash=<tick>` self-SIGKILLs, `journal_corrupt=1`
+    flips a journal byte first). A successor controller then takes the
+    lease and must adopt, resume/rollback, rebuild, or fence per the
+    scenario's invariants."""
+
+    name: str
+    spot_size: int = 0
+    serve_size: int = 0
+    rollout_to: str = ""
+    rollout_window_s: float = 2.5
+    kill_at_rollout_state: str | None = None
+    wait_before_successor_s: float = 0.0  # let a journaled window expire
+    faults: str = ""
+    pause_leader: bool = False
+    converge_timeout_s: float = 15.0
+    invariants: dict = field(default_factory=dict)
+
+
+CONTROLLER_MATRIX = [
+    ControllerScenario(
+        # kill -9 mid-canary with window time left: the successor must
+        # re-adopt the live canary from the manifest and serve out the
+        # REMAINING window, then finish the rollout — 1 fresh spawn (the
+        # second wave's canary), everything else adopted.
+        name="crash-mid-rollout-resume",
+        spot_size=1,
+        serve_size=2,
+        rollout_to="v2",
+        rollout_window_s=2.5,
+        kill_at_rollout_state="canary",
+        converge_timeout_s=25.0,
+        invariants={
+            "rollout_resumes": 1,
+            "rollout_result": "done",
+            "adopted_all": True,
+            "spawns": 1,
+            "journal_rebuilds": 0,
+            "serve_versions": ["v2", "v2"],
+            "converged": True,
+        },
+    ),
+    ControllerScenario(
+        # kill -9 mid-canary and let the journaled verdict window EXPIRE
+        # before the successor starts: the canary carried live weight with
+        # nobody watching, so the only safe resume is rollback.
+        name="crash-expired-window-rollback",
+        spot_size=1,
+        serve_size=1,
+        rollout_to="v2",
+        rollout_window_s=1.0,
+        kill_at_rollout_state="canary",
+        wait_before_successor_s=2.0,
+        invariants={
+            "rollout_resumes": 1,
+            "rollout_result": "rolled_back",
+            "adopted_all": True,
+            "spawns": 0,
+            "serve_versions": ["v1"],
+            "converged": True,
+        },
+    ),
+    ControllerScenario(
+        # kill -9 mid-preemption-storm: preempt files written, children
+        # exiting 83, THEN the controller dies — the classic lingering-
+        # marker trap. The successor must adopt every live supervisor
+        # (0 double-spawns), clear the stale markers, and reconverge.
+        name="crash-mid-storm",
+        spot_size=3,
+        invariants={
+            "adoptions": 3,
+            "adopted_all": True,
+            "spawns": 0,
+            "journal_rebuilds": 0,
+            "converged": True,
+        },
+    ),
+    ControllerScenario(
+        # journal_corrupt flips a byte of the leader's own journal, then
+        # controller_crash SIGKILLs it: the successor's load must FAIL the
+        # CRC (detected, not replayed), count one rebuild-from-observation,
+        # and re-seed desired state from the manifest it can verify.
+        name="journal-corrupt-rebuild",
+        spot_size=2,
+        faults="journal_corrupt=1,controller_crash=3",
+        invariants={
+            "journal_rebuilds": 1,
+            "adoptions": 2,
+            "adopted_all": True,
+            "spawns": 0,
+            "converged": True,
+        },
+    ),
+    ControllerScenario(
+        # stale-leader fencing: SIGSTOP the leader past its TTL, let the
+        # standby take over (epoch +1), SIGCONT the old leader — its next
+        # actuation-boundary check must raise StaleLeaderError (counted)
+        # and demote it, never touch the fleet.
+        name="stale-leader-fencing",
+        spot_size=1,
+        pause_leader=True,
+        invariants={
+            "fencing_rejections_ge": 1,
+            "old_leader_demoted": True,
+            "epoch_monotonic": True,
+            "converged": True,
+        },
+    ),
+]
+
+
+class ControllerProc:
+    """One controller subprocess + its status-file protocol."""
+
+    def __init__(self, workdir: str, state_dir: str, manifest: str,
+                 owner: str, extra_args: list | None = None,
+                 faults_spec: str = "") -> None:
+        import subprocess
+        import sys
+
+        from spotter_tpu.testing import cluster
+
+        self.owner = owner
+        self.status_path = f"{state_dir}/status-{owner}.json"
+        self.log_path = f"{workdir}/{owner}.log"
+        self._log_file = open(self.log_path, "w")
+        cmd = [
+            sys.executable, "-m", "spotter_tpu.serving.reconcile",
+            "--state-dir", state_dir, "--manifest", manifest,
+            "--workdir", workdir, "--owner", owner,
+            "--tick", "0.1", "--lease-ttl", "0.8",
+        ] + list(extra_args or [])
+        env = cluster._hermetic_env(
+            {faults.FAULTS_ENV: faults_spec} if faults_spec else None
+        )
+        self.proc = subprocess.Popen(
+            cmd, env=env, cwd=cluster.REPO_ROOT,
+            stdout=self._log_file, stderr=subprocess.STDOUT, text=True,
+        )
+
+    def status(self) -> dict:
+        import json
+
+        try:
+            with open(self.status_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def wait_status(self, pred, timeout_s: float, what: str) -> dict:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        last: dict = {}
+        while _time.monotonic() < deadline:
+            last = self.status()
+            try:
+                if last and pred(last):
+                    return last
+            except (KeyError, TypeError, AttributeError):
+                pass
+            if self.proc.poll() is not None and not last:
+                break
+            _time.sleep(0.05)
+        raise TimeoutError(
+            f"{self.owner}: {what} not reached in {timeout_s} s "
+            f"(last status: {last}, exit: {self.proc.poll()})"
+        )
+
+    def sigkill(self) -> None:
+        import signal as _signal
+
+        self.proc.send_signal(_signal.SIGKILL)
+        self.proc.wait()
+
+    def sigstop(self) -> None:
+        import signal as _signal
+
+        self.proc.send_signal(_signal.SIGSTOP)
+
+    def sigcont(self) -> None:
+        import signal as _signal
+
+        self.proc.send_signal(_signal.SIGCONT)
+
+    def shutdown(self, timeout_s: float = 10.0) -> None:
+        import signal as _signal
+        import subprocess
+
+        if self.proc.poll() is None:
+            self.proc.send_signal(_signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        self._log_file.close()
+
+
+def _teardown_members(manifest_path: str) -> None:
+    """Best-effort fleet teardown: SIGTERM every registered supervisor
+    (it forwards to its child and deregisters), then SIGKILL stragglers."""
+    import signal as _signal
+    import time as _time
+
+    from spotter_tpu.serving.statestore import (
+        EndpointsManifest,
+        supervisor_alive,
+    )
+
+    manifest = EndpointsManifest(manifest_path)
+    pids = [
+        int(e.get("supervisor_pid") or 0)
+        for e in manifest.entries().values()
+    ]
+    for pid in pids:
+        if supervisor_alive(pid):
+            try:
+                import os as _os
+
+                _os.kill(pid, _signal.SIGTERM)
+            except OSError:
+                pass
+    deadline = _time.monotonic() + 10.0
+    while _time.monotonic() < deadline and any(
+        supervisor_alive(p) for p in pids
+    ):
+        _time.sleep(0.1)
+    for pid in pids:
+        if supervisor_alive(pid):
+            try:
+                import os as _os
+
+                _os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                pass
+
+
+def run_controller_scenario(
+    sc: ControllerScenario,
+    workdir: str,
+    on_ready=None,
+    on_converged=None,
+) -> dict:
+    """Execute one controller chaos drill in `workdir`; returns the
+    report dict (see `evaluate_controller`).
+
+    `on_ready` fires once the harness serve members answer /startupz
+    (before the first controller starts); `on_converged` fires when the
+    scenario reaches its verdict (success or convergence timeout), BEFORE
+    teardown — the window where bench.py keeps client load flowing, so
+    teardown's deliberate mass-SIGTERM never counts as client failures."""
+    import os as _os
+    import time as _time
+
+    from spotter_tpu.serving.statestore import EndpointsManifest
+    from spotter_tpu.testing import cluster
+
+    sc_dir = _os.path.join(workdir, sc.name)
+    state_dir = _os.path.join(sc_dir, "state")
+    members_dir = _os.path.join(sc_dir, "members")
+    _os.makedirs(state_dir, exist_ok=True)
+    _os.makedirs(members_dir, exist_ok=True)
+    manifest_path = _os.path.join(sc_dir, "endpoints.json")
+    manifest = EndpointsManifest(manifest_path)
+
+    ctl_args = []
+    if sc.spot_size:
+        ctl_args += ["--pool", f"spot={sc.spot_size}"]
+    if sc.serve_size:
+        ctl_args += [
+            "--serve-pool", "serve", "--serve-size", str(sc.serve_size),
+            "--serve-version", "v1",
+        ]
+    if sc.rollout_to:
+        ctl_args += [
+            "--rollout-to", sc.rollout_to,
+            "--rollout-window", str(sc.rollout_window_s),
+            "--rollout-min-requests", "0",
+            "--drain-ms", "500",
+        ]
+
+    serve_members = []
+    controllers: list[ControllerProc] = []
+    report: dict = {"name": sc.name}
+    try:
+        # harness-spawned v1 serve members (the rollout's old cohort)
+        spawn_v1 = cluster.rollout_spawner(
+            members_dir, "v1", pool="serve", manifest=manifest_path
+        )
+        for _ in range(sc.serve_size):
+            serve_members.append(spawn_v1())
+        for m in serve_members:
+            cluster.wait_ready(m.url)
+        if on_ready is not None:
+            on_ready()
+
+        a = ControllerProc(sc_dir, state_dir, manifest_path, "ctrl-a",
+                           ctl_args, faults_spec=sc.faults)
+        controllers.append(a)
+
+        def _spot_ready(st: dict) -> bool:
+            return (
+                st.get("phase") == "leading"
+                and st["reconcile"]["drift"].get("spot") == 0
+            )
+
+        if sc.faults:
+            # tick-deterministic death: the fault plan kills A itself
+            import subprocess
+
+            try:
+                a.proc.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                raise TimeoutError(
+                    f"{sc.name}: fault plan {sc.faults!r} never killed "
+                    "the first controller"
+                ) from None
+            report["first_exit"] = a.proc.poll()
+        elif sc.kill_at_rollout_state:
+            a.wait_status(
+                lambda st: (st.get("rollout") or {}).get("state")
+                == sc.kill_at_rollout_state,
+                30.0, f"rollout state {sc.kill_at_rollout_state}",
+            )
+            a.sigkill()
+        elif sc.pause_leader:
+            a.wait_status(_spot_ready, 30.0, "spot pool converged")
+        else:
+            # crash-mid-storm: converge, storm half the pool via the
+            # members' maintenance files, then kill -9 the leader while
+            # the storm is still in flight
+            a.wait_status(_spot_ready, 30.0, "spot pool converged")
+            stormed = 0
+            for url, entry in sorted(manifest.entries().items()):
+                if entry.get("pool") != "spot" or stormed >= 2:
+                    continue
+                pf = entry.get("preempt_file") or ""
+                if pf:
+                    tmp = f"{pf}.tmp"
+                    with open(tmp, "w") as f:
+                        f.write("injected preemption storm")
+                    _os.replace(tmp, pf)
+                    stormed += 1
+            report["stormed"] = stormed
+            _time.sleep(0.4)  # children draining/exiting 83 right now
+            a.sigkill()
+
+        if sc.wait_before_successor_s:
+            _time.sleep(sc.wait_before_successor_s)
+        report["alive_at_takeover"] = sum(
+            1 for e in manifest.entries().values()
+            if _supervisor_alive(e)
+        )
+
+        b = ControllerProc(sc_dir, state_dir, manifest_path, "ctrl-b",
+                           ctl_args)
+        controllers.append(b)
+
+        if sc.pause_leader:
+            a.sigstop()
+            b.wait_status(
+                lambda st: st.get("phase") == "leading",
+                30.0, "standby takeover",
+            )
+            a.sigcont()
+            a_status = a.wait_status(
+                lambda st: st.get("phase") == "deposed"
+                and st["reconcile"]["fencing_rejections_total"] >= 1,
+                15.0, "stale leader fenced",
+            )
+            report["old_leader"] = a_status
+
+        def _converged(st: dict) -> bool:
+            if st.get("phase") != "leading":
+                return False
+            rec = st["reconcile"]
+            if sc.spot_size and rec["drift"].get("spot") != 0:
+                return False
+            if sc.rollout_to and st.get("rollout_result") is None:
+                return False
+            return bool(rec["converged"])
+
+        t0 = _time.monotonic()
+        final = b.wait_status(
+            _converged, sc.converge_timeout_s, "successor convergence"
+        )
+        report["converge_s"] = _time.monotonic() - t0
+        report["converged"] = True
+        report["successor"] = final
+        report["serve_versions"] = sorted(
+            str(e.get("version") or "")
+            for e in manifest.entries().values()
+            if e.get("pool") == "serve" and _supervisor_alive(e)
+        )
+        if on_converged is not None:
+            on_converged()
+    except TimeoutError as exc:
+        report["converged"] = False
+        report["error"] = str(exc)
+        report.setdefault("alive_at_takeover", None)
+        report.setdefault("successor", controllers[-1].status()
+                          if controllers else {})
+        report.setdefault("serve_versions", [])
+        if on_converged is not None:
+            on_converged()
+    finally:
+        for ctl in controllers:
+            ctl.shutdown()
+        _teardown_members(manifest_path)
+        for m in serve_members:
+            try:
+                m.shutdown(timeout_s=2.0)
+            except Exception:
+                pass
+
+    report["checks"] = evaluate_controller(sc, report)
+    report["ok"] = all(report["checks"].values())
+    return report
+
+
+def _supervisor_alive(entry: dict) -> bool:
+    from spotter_tpu.serving.statestore import supervisor_alive
+
+    return supervisor_alive(int(entry.get("supervisor_pid") or 0))
+
+
+def evaluate_controller(sc: ControllerScenario, report: dict) -> dict:
+    """Invariant name -> bool for every invariant the scenario declares."""
+    succ = (report.get("successor") or {}).get("reconcile") or {}
+    old = (report.get("old_leader") or {})
+    checks: dict[str, bool] = {}
+    for key, want in sc.invariants.items():
+        if key == "rollout_resumes":
+            checks[key] = succ.get("rollout_resumes_total") == want
+        elif key == "rollout_result":
+            checks[key] = (
+                report.get("successor", {}).get("rollout_result") == want
+            )
+        elif key == "adoptions":
+            checks[key] = succ.get("adoptions_total") == want
+        elif key == "adopted_all":
+            checks[key] = (
+                succ.get("adoptions_total") == report.get("alive_at_takeover")
+            ) == want
+        elif key == "spawns":
+            checks[key] = succ.get("spawns_total") == want
+        elif key == "journal_rebuilds":
+            checks[key] = succ.get("journal_rebuilds_total") == want
+        elif key == "serve_versions":
+            checks[key] = report.get("serve_versions") == want
+        elif key == "converged":
+            checks[key] = report.get("converged") == want
+        elif key == "fencing_rejections_ge":
+            checks[key] = (
+                (old.get("reconcile") or {}).get("fencing_rejections_total", 0)
+                >= want
+            )
+        elif key == "old_leader_demoted":
+            checks[key] = (old.get("phase") == "deposed") == want
+        elif key == "epoch_monotonic":
+            # takeover must FENCE: strictly higher epoch than the deposed
+            # leader ever held
+            succ_epoch = report.get("successor", {}).get("epoch", 0)
+            checks[key] = (succ_epoch > old.get("epoch", 0) >= 1) == want
+        else:
+            raise ValueError(f"unknown invariant {key!r} in {sc.name}")
+    return checks
+
+
+def run_controller_matrix(
+    workdir: str, scenarios: list[ControllerScenario] | None = None,
+) -> list[dict]:
+    """Run every controller chaos drill; returns the reports — same
+    contract as `run_matrix`."""
+    reports = []
+    for sc in scenarios if scenarios is not None else CONTROLLER_MATRIX:
+        reports.append(run_controller_scenario(sc, workdir))
+    return reports

@@ -67,10 +67,12 @@ class SupervisedReplica:
         backoff_base_s: float = 0.2,
         min_uptime_s: float = 0.5,
         env: dict | None = None,
+        manifest: str | None = None,
     ) -> None:
         self.port = port
         self.url = f"http://127.0.0.1:{port}"
         self.pidfile = pidfile
+        self.manifest = manifest
         # file-backed output, NOT a pipe: nothing drains a pipe until
         # shutdown(), so a long-lived member (health probes log every poll)
         # would fill the 64 KB pipe buffer and block the server on a stdout
@@ -82,6 +84,12 @@ class SupervisedReplica:
             "--backoff-base", str(backoff_base_s),
             "--min-uptime", str(min_uptime_s),
             "--pidfile", pidfile,
+        ]
+        if manifest:
+            # ISSUE 16: the supervisor self-registers in the endpoints
+            # manifest so a (re)started controller can adopt this member
+            cmd += ["--manifest", manifest, "--url", self.url]
+        cmd += [
             "--",
             sys.executable, "-m", "spotter_tpu.serving.standalone",
             "--stub-engine", "--no-warmup",
@@ -197,7 +205,8 @@ class FleetMember(SupervisedReplica):
 
 
 def rollout_spawner(workdir: str, version: str, pool: str = "on_demand",
-                    env: dict | None = None, **replica_kwargs):
+                    env: dict | None = None, manifest: str | None = None,
+                    **replica_kwargs):
     """Factory for `RolloutController`'s spawner over REAL subprocess
     members (ISSUE 15): each call spawns one supervised stub replica with
     `SPOTTER_TPU_BUILD_VERSION=<version>` in its environment, so the
@@ -208,7 +217,8 @@ def rollout_spawner(workdir: str, version: str, pool: str = "on_demand",
     member_env = {"SPOTTER_TPU_BUILD_VERSION": version}
     if env:
         member_env.update(env)
-    base = fleet_spawner(workdir, pool, env=member_env, **replica_kwargs)
+    base = fleet_spawner(workdir, pool, env=member_env, manifest=manifest,
+                         **replica_kwargs)
 
     def spawn() -> FleetMember:
         member = base()
@@ -219,12 +229,13 @@ def rollout_spawner(workdir: str, version: str, pool: str = "on_demand",
 
 
 def fleet_spawner(workdir: str, pool: str, env: dict | None = None,
-                  **replica_kwargs):
+                  manifest: str | None = None, **replica_kwargs):
     """Factory for `FleetController` PoolSpec.spawner: each call spawns one
     FleetMember on a fresh ephemeral port with its own pidfile + maintenance
     file under `workdir`. The member is returned immediately (HTTP binds
     before bring-up); the controller's health loop promotes it when
-    /healthz goes 200."""
+    /healthz goes 200. With `manifest=` every member self-registers in the
+    endpoints manifest (ISSUE 16 adoption surface)."""
 
     def spawn() -> FleetMember:
         (port,) = pick_ports(1)
@@ -235,6 +246,7 @@ def fleet_spawner(workdir: str, pool: str, env: dict | None = None,
             os.path.join(workdir, f"{tag}.preempt"),
             pool=pool,
             env=env,
+            manifest=manifest,
             **replica_kwargs,
         )
 

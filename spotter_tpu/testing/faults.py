@@ -79,6 +79,19 @@ matrix (testing/chaos_matrix.py) and `bench.py --gray-storm` compose:
   byte flipped after encoding (`corrupt_frame_bytes`), so the edge's CRC
   validator (wire.py v2) must catch each one, count it, and replay on
   another replica with zero client-visible errors.
+
+The control-plane tier (ISSUE 16) adds the two faults the controller
+chaos matrix (CONTROLLER_MATRIX) composes:
+
+- `controller_crash=<tick>`: the reconcile controller process
+  (serving/reconcile.py) consumes one unit per main-loop tick via
+  `take_controller_crash()` and SIGKILLs ITSELF when the countdown hits
+  zero — a deterministic kill -9 at a chosen point in the reconcile
+  cycle (mid-rollout, mid-storm), with no external kill racing the tick.
+- `journal_corrupt=1`: on the armed tick the controller flips one byte
+  of its own state journal on disk (`take_journal_corrupt()`), so the
+  NEXT controller's load fails the CRC and must take the counted
+  rebuild-from-observation path instead of replaying damaged intent.
 """
 
 import asyncio
@@ -141,6 +154,11 @@ class FaultPlan:
     # matrix runs N stub replicas in one process and only the "bad deploy"
     # canary must misbehave. Empty = unscoped (every replica).
     only_replica: str = ""
+    # ISSUE 16 control-plane tier: SIGKILL the controller on the Nth
+    # main-loop tick (countdown; 0 = disarmed), and arm a one-shot
+    # flip-a-journal-byte so the NEXT load must rebuild from observation
+    controller_crash: int = 0
+    journal_corrupt: int = 0
     # set() to un-wedge hanging engine calls early (tests)
     release: threading.Event = field(default_factory=threading.Event)
     _lock: threading.Lock = field(default_factory=threading.Lock)
@@ -208,6 +226,8 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "flaky",
             "corrupt_frame",
             "only_replica",
+            "controller_crash",
+            "journal_corrupt",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
         if key == "slow_stage":
@@ -419,6 +439,35 @@ def take_flaky(replica_id: str | None = None) -> bool:
             plan._flaky_credit -= 100
             return True
     return False
+
+
+# ---- control-plane tier (ISSUE 16) ----
+
+
+def take_controller_crash() -> bool:
+    """Reconcile-controller hook, one call per main-loop tick: True when
+    the armed countdown reaches zero — the tick on which the controller
+    must SIGKILL itself. `controller_crash=3` crashes ON the 3rd tick, so
+    a drill can place the kill deterministically inside a rollout wave or
+    a preemption storm instead of racing an external kill."""
+    plan = _active
+    if plan is None or plan.controller_crash <= 0:
+        return False
+    with plan._lock:
+        if plan.controller_crash <= 0:
+            return False
+        plan.controller_crash -= 1
+        return plan.controller_crash == 0
+
+
+def take_journal_corrupt() -> bool:
+    """Reconcile-controller hook: consume the one-shot journal-corruption
+    arm. The controller flips a byte of its own journal on disk; the next
+    load fails the CRC and rebuilds from observation (counted)."""
+    plan = _active
+    if plan is None:
+        return False
+    return plan._consume("journal_corrupt")
 
 
 def corrupt_frame_bytes(data: bytes, replica_id: str | None = None) -> bytes:

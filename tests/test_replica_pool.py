@@ -544,3 +544,57 @@ def test_pinned_weight_holds_canary_share():
             await r.stop()
 
     asyncio.run(run())
+
+
+def test_probe_in_flight_cannot_resurrect_retired_member():
+    """The retire/adopt race (ISSUE 16 satellite): a health probe that was
+    awaiting /healthz when the member was retired must NOT mutate the stale
+    Replica on completion — success would mark a retiring member healthy
+    mid-drain, and failure on a removed-and-readded URL would smear state
+    onto an object no longer in the ring."""
+
+    async def run():
+        replicas, urls = await _with_replicas(2)
+        pool = ReplicaPool(urls, health_interval_s=30.0)
+        url = urls[0]
+        r = pool.replica_for(url)
+        r.healthy = False
+        r.consecutive_failures = 3
+
+        gate = asyncio.Event()
+        real_get = pool.client.get
+
+        async def gated_get(u, **kw):
+            await gate.wait()
+            return await real_get(u, **kw)
+
+        pool.client.get = gated_get
+        probe = asyncio.create_task(pool._probe(r))
+        await asyncio.sleep(0.02)  # probe parked on the gate
+        # the retire path runs while the probe is in flight
+        assert pool.remove_endpoint(url) is r
+        gate.set()
+        await probe  # /healthz answers 200 for a replica no longer pooled
+        assert pool.replica_for(url) is None
+        assert r.healthy is False  # the stale object was not "promoted"
+        assert r.consecutive_failures == 3
+
+        # removed-and-readded: the NEW Replica ("starting") must only be
+        # promoted by ITS OWN probe, never by the stale one completing
+        gate.clear()
+        probe = asyncio.create_task(pool._probe(r))
+        await asyncio.sleep(0.02)
+        r2 = pool.add_endpoint(url, healthy=False)
+        assert r2 is not r
+        gate.set()
+        await probe
+        assert r2.healthy is False
+        await pool._probe(r2)  # its own probe promotes it
+        assert r2.healthy is True
+
+        pool.client.get = real_get
+        await pool.stop()
+        for rep in replicas:
+            await rep.stop()
+
+    asyncio.run(run())

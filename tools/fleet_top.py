@@ -15,7 +15,11 @@ flag exists for edges fronted by auth proxies that expect the header.
 
 Reads the `fleet` block the aggregator embeds in /metrics. An edge with
 the aggregator disabled (SPOTTER_TPU_FLEET_SCRAPE_S=0) has no such block;
-that is reported rather than rendered as an empty fleet.
+that is reported rather than rendered as an empty fleet. A
+controller-wired edge (ISSUE 16) also carries a `reconcile` block, which
+renders as a `control:` line — leadership + fencing epoch and the
+desired-vs-observed drift per pool — so an operator sees "spot 2/3
+ready" next to the replica rows it explains.
 """
 
 import argparse
@@ -40,6 +44,40 @@ COLUMNS = (
     ("HIT%", 6, "cache_hit_rate", lambda v: f"{100.0 * float(v or 0):.0f}"),
     ("RUNG", 4, "brownout_rung", lambda v: str(int(v or 0))),
 )
+
+
+def _control_plane(snapshot: dict) -> str | None:
+    """The reconciler line (ISSUE 16): desired-vs-observed drift per pool,
+    from the `reconcile` block a controller-wired edge embeds in /metrics.
+    None (not an empty line) when the edge has no control plane attached."""
+    rec = snapshot.get("reconcile")
+    if not isinstance(rec, dict):
+        return None
+    role = "leading" if rec.get("leader") else "standby"
+    detail = rec.get("drift_detail") or {}
+    drift = rec.get("drift") or {}
+    pools = []
+    for pool in sorted(set(drift) | set(detail)):
+        d = detail.get(pool) or {}
+        ready = d.get("ready")
+        desired = d.get("desired")
+        if ready is None or desired is None:
+            pools.append(f"{pool} drift {int(drift.get(pool, 0) or 0):+d}")
+        else:
+            pools.append(f"{pool} {int(ready)}/{int(desired)} ready")
+    state = (
+        "converged" if rec.get("converged")
+        else f"drift {int(rec.get('drift_total', 0) or 0)}"
+    )
+    return (
+        f"control: {role} epoch {int(rec.get('epoch', 0) or 0)} "
+        f"({rec.get('owner') or '-'}) | {state}"
+        + (" | " + ", ".join(pools) if pools else "")
+        + f" | adopted {int(rec.get('adoptions_total', 0) or 0)}"
+        f" spawned {int(rec.get('spawns_total', 0) or 0)}"
+        f" fenced {int(rec.get('fencing_rejections_total', 0) or 0)}"
+        f" rebuilt {int(rec.get('journal_rebuilds_total', 0) or 0)}"
+    )
 
 
 def _state(row: dict) -> str:
@@ -72,7 +110,11 @@ def render(snapshot: dict) -> str:
         f"mfu {float(fleet.get('mfu_pct', 0) or 0):.1f}% | "
         f"rung {int(fleet.get('brownout_rung', 0) or 0)}"
     )
-    lines = [head, ""]
+    lines = [head]
+    control = _control_plane(snapshot)
+    if control is not None:
+        lines.append(control)
+    lines.append("")
     header = "  ".join(h.ljust(w) for h, w, _, _ in COLUMNS)
     lines.append(header)
     for row in fleet.get("per_replica") or []:
