@@ -1973,6 +1973,404 @@ def gray_storm_bench(args) -> int:
     return 0 if passed else 1
 
 
+def integrity_drill_bench(args) -> int:
+    """Output-integrity plane, measured (ISSUE 17 acceptance): model-free
+    stub replicas behind the REAL router + ReplicaPool + QuorumSampler,
+    every replica passing verified readiness (attest + golden probe via a
+    real IntegrityPlane) before joining. Three phases:
+
+    1. **SDC storm**: closed-loop load over N verified replicas; mid-load
+       one starts answering plausible garbage for 100%% of its traffic
+       (the `faults.py sdc` seam) while returning HTTP 200 and healthy
+       /healthz — the signature no transport check can see. Gates:
+       time-to-quarantine <= 10 s, zero client failures, and zero wrong
+       answers after the quarantine settles (the exposure window CLOSES).
+    2. **Never-serve + false-positive rows**: the INTEGRITY chaos-matrix
+       corrupt-weights / corrupt-compile-cache rows (exit 86 at the
+       readiness gate, zero requests served by the corrupt replica) and
+       the false-positive row (slow-but-correct + masked flaky 500s:
+       ZERO quarantines).
+    3. **Unloaded overhead**: the whole integrity plane (periodic golden
+       probe + attestation loops on every replica + edge quorum
+       sampling) ON vs OFF, interleaved paired rounds over one shared
+       replica set (the --fleet-obs protocol). Gate: median paired p50
+       delta < 1%%.
+
+    Prints ONE JSON line accepted by tools/bench_compare.py; exits
+    non-zero when any gate fails.
+    """
+    import asyncio
+    import contextlib
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.obs import compare
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving.detector import AmenitiesDetector
+    from spotter_tpu.serving.integrity import IntegrityPlane, QuorumSampler
+    from spotter_tpu.serving.replica_pool import ReplicaPool
+    from spotter_tpu.serving.router import make_router_app
+    from spotter_tpu.serving.standalone import make_app
+    from spotter_tpu.testing import faults
+    from spotter_tpu.testing.chaos_matrix import (
+        INTEGRITY_MATRIX,
+        run_integrity_scenario,
+    )
+    from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+    n_replicas = args.integrity_replicas
+    service_ms = args.integrity_service_ms
+    concurrency = args.integrity_concurrency
+    quorum_pct = args.integrity_quorum_pct
+    quarantine_gate_s = 10.0
+    overhead_gate_pct = 1.0
+    urls_cycle = [f"http://integ.example.com/img-{i}.jpg" for i in range(32)]
+
+    async def build_fleet(count: int, replica_prefix: str):
+        """Verified stub replicas: each passes the attest + golden-probe
+        readiness gate (a real IntegrityPlane) before it may serve."""
+        engines, dets, planes, servers, urls = [], [], [], [], []
+        for i in range(count):
+            engine = StubEngine(service_ms=service_ms)
+            engine.metrics.set_identity(replica_id=f"{replica_prefix}{i}")
+            det = AmenitiesDetector(
+                engine,
+                MicroBatcher(engine, max_delay_ms=1.0),
+                StubHttpClient(),
+            )
+            plane = IntegrityPlane(
+                engine, det.batcher, family="stub",
+                probe_interval_s=0, attest_interval_s=0,
+                exit_cb=lambda code: (_ for _ in ()).throw(
+                    AssertionError(f"unexpected integrity exit {code}")
+                ),
+            )
+            assert await plane.verify("cold-start"), plane.last_error
+            server = TestServer(make_app(detector=det))
+            await server.start_server()
+            engines.append(engine)
+            dets.append(det)
+            planes.append(plane)
+            servers.append(server)
+            urls.append(f"http://{server.host}:{server.port}")
+        return engines, dets, planes, servers, urls
+
+    async def teardown(dets, servers):
+        for server in servers:
+            await server.close()
+        for det in dets:
+            await det.aclose()
+
+    async def sdc_storm() -> dict:
+        engines, dets, planes, servers, urls = await build_fleet(
+            n_replicas, "integ-bench-r"
+        )
+        pool = ReplicaPool(urls, health_interval_s=0.1, adaptive_hedge=True)
+        quorum = QuorumSampler(
+            pool,
+            pct=quorum_pct,
+            # drill-fast evidence knobs (the chaos-matrix calibration):
+            # alpha .5 / threshold .6 -> two charged disagreements past
+            # min_samples trip the quarantine
+            ewma_threshold=0.6,
+            min_samples=3,
+            alpha=0.5,
+        )
+        app = make_router_app(
+            pool,
+            aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+            quorum=quorum,
+        )
+        # (t_done, ok, wrong)
+        events: list[tuple[float, bool, bool]] = []
+        stop = {"flag": False}
+        t_quarantine = {"t": None}
+        expected: dict[str, list] = {}
+        async with TestClient(TestServer(app)) as client:
+            # pin every URL's honest answer BEFORE the fault is armed
+            for url in urls_cycle:
+                resp = await client.post(
+                    "/detect", json={"image_urls": [url]}
+                )
+                body = await resp.json()
+                assert resp.status == 200, (resp.status, body)
+                expected[url] = [
+                    img.get("detections") for img in body.get("images", [])
+                ]
+
+            counter = {"i": 0}
+
+            async def worker() -> None:
+                while not stop["flag"]:
+                    i = counter["i"]
+                    counter["i"] += 1
+                    url = urls_cycle[i % len(urls_cycle)]
+                    resp = await client.post(
+                        "/detect", json={"image_urls": [url]}
+                    )
+                    ok = resp.status == 200
+                    wrong = False
+                    if ok:
+                        body = await resp.json()
+                        got = [
+                            img.get("detections")
+                            for img in body.get("images", [])
+                        ]
+                        wrong = not compare.images_equivalent(
+                            expected[url], got
+                        )
+                    else:
+                        await resp.read()
+                    events.append((time.perf_counter(), ok, wrong))
+
+            async def watcher() -> None:
+                while not stop["flag"]:
+                    if (
+                        t_quarantine["t"] is None
+                        and pool.quarantines_total > 0
+                    ):
+                        t_quarantine["t"] = time.perf_counter()
+                    await asyncio.sleep(0.02)
+
+            workers = [
+                asyncio.create_task(worker()) for _ in range(concurrency)
+            ]
+            watcher_task = asyncio.create_task(watcher())
+            await asyncio.sleep(args.integrity_baseline_s)
+            t_inject = time.perf_counter()
+            with contextlib.ExitStack() as stack:
+                # the silent corruption: replica 0 answers garbage for
+                # 100% of its traffic, HTTP stays 200, health stays green
+                stack.enter_context(
+                    faults.inject(
+                        sdc=100,
+                        only_replica=engines[0].metrics.replica_id,
+                    )
+                )
+                await asyncio.sleep(args.integrity_storm_s)
+                stop["flag"] = True
+                await asyncio.gather(*workers, watcher_task)
+                # let in-flight fire-and-forget quorum samples settle
+                await asyncio.sleep(0.1)
+            snap = pool.snapshot()
+            qsnap = quorum.snapshot()
+        await pool.stop()
+        await teardown(dets, servers)
+
+        tq = t_quarantine["t"]
+        time_to_quarantine = (tq - t_inject) if tq is not None else None
+        failures = sum(1 for _, ok, _ in events if not ok)
+        wrong_total = sum(1 for _, _, wrong in events if wrong)
+        # the exposure window must CLOSE: after the quarantine settles
+        # (in-flight requests at the flip drain within the settle window)
+        # not one more wrong answer reaches a client
+        settle_s = 0.5
+        wrong_after = (
+            sum(1 for t, _, wrong in events if wrong and t > tq + settle_s)
+            if tq is not None
+            else wrong_total
+        )
+        sdc_quarantined = any(
+            r["url"] == urls[0] and r.get("quarantined")
+            for r in snap["replicas"]
+        )
+        return {
+            "requests": len(events),
+            "client_failures": failures,
+            "time_to_quarantine_s": time_to_quarantine,
+            "sdc_quarantined": sdc_quarantined,
+            "wrong_answers": wrong_total,
+            "wrong_after_settle": wrong_after,
+            "quorum": qsnap,
+        }
+
+    async def matrix_rows() -> list[dict]:
+        rows = [
+            sc
+            for sc in INTEGRITY_MATRIX
+            if sc.name
+            in (
+                "corrupt-weights",
+                "corrupt-compile-cache",
+                "false-positive-immunity",
+            )
+        ]
+        return [await run_integrity_scenario(sc) for sc in rows]
+
+    async def overhead() -> dict:
+        """Integrity plane ON vs OFF, paired rounds, ONE shared replica
+        set (the --fleet-obs protocol). ON arms the periodic probe +
+        attestation loop on every replica at an aggressive cadence plus
+        edge quorum sampling; OFF is the same fleet with the plane dark."""
+        engines, dets, planes, servers, urls = await build_fleet(
+            n_replicas, "integ-ovh-r"
+        )
+        # re-arm the planes for the periodic loop (verification used
+        # run-once intervals)
+        for plane in planes:
+            plane.probe_interval_s = args.integrity_overhead_interval_s
+            plane.attest_interval_s = args.integrity_overhead_interval_s
+        pool_off = ReplicaPool(urls, health_interval_s=0.25)
+        app_off = make_router_app(
+            pool_off, aggregator=FleetAggregator(lambda: [], interval_s=0.0)
+        )
+        pool_on = ReplicaPool(urls, health_interval_s=0.25)
+        quorum_on = QuorumSampler(
+            pool_on, pct=args.integrity_overhead_quorum_pct
+        )
+        app_on = make_router_app(
+            pool_on,
+            aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+            quorum=quorum_on,
+        )
+        off: list[float] = []
+        on: list[float] = []
+        paired: list[float] = []
+        async with TestClient(TestServer(app_off)) as c_off, TestClient(
+            TestServer(app_on)
+        ) as c_on:
+
+            async def slice_requests(client, lats: list[float]) -> None:
+                for i in range(args.integrity_overhead_requests):
+                    t0 = time.perf_counter()
+                    resp = await client.post(
+                        "/detect",
+                        json={
+                            "image_urls": [urls_cycle[i % len(urls_cycle)]]
+                        },
+                    )
+                    await resp.read()
+                    assert resp.status == 200, f"HTTP {resp.status}"
+                    lats.append(time.perf_counter() - t0)
+
+            # warm both paths
+            await slice_requests(c_off, [])
+            await slice_requests(c_on, [])
+            for r in range(args.integrity_overhead_rounds):
+                order = (False, True) if r % 2 == 0 else (True, False)
+                pair: dict[bool, list[float]] = {False: [], True: []}
+                for armed in order:
+                    if armed:
+                        # replica-side periodic probe+attest loops run
+                        # ONLY during the armed slice
+                        for plane in planes:
+                            await plane.start()
+                    try:
+                        await slice_requests(
+                            c_on if armed else c_off, pair[armed]
+                        )
+                    finally:
+                        if armed:
+                            for plane in planes:
+                                await plane.aclose()
+                off.extend(pair[False])
+                on.extend(pair[True])
+                off_p50 = float(np.median(pair[False]))
+                on_p50 = float(np.median(pair[True]))
+                if off_p50 > 0:
+                    paired.append((on_p50 - off_p50) / off_p50 * 100.0)
+        probes = sum(p.probe.probes_total for p in planes)
+        attests = sum(p.attestor.attests_total for p in planes)
+        await pool_off.stop()
+        await pool_on.stop()
+        await teardown(dets, servers)
+        return {
+            "p50_off_ms": float(np.median(off)) * 1e3,
+            "p50_on_ms": float(np.median(on)) * 1e3,
+            "paired_deltas_pct": paired,
+            "delta_pct": float(np.median(paired)) if paired else 0.0,
+            "quorum_samples": quorum_on.samples_total,
+            "probes": probes,
+            "attests": attests,
+        }
+
+    storm = asyncio.run(sdc_storm())
+    rows = asyncio.run(matrix_rows())
+    ovh = asyncio.run(overhead())
+
+    by_name = {r["name"]: r for r in rows}
+    cw = by_name["corrupt-weights"]
+    cc = by_name["corrupt-compile-cache"]
+    fp = by_name["false-positive-immunity"]
+    gates = {
+        "quarantine_within_10s": (
+            storm["time_to_quarantine_s"] is not None
+            and storm["time_to_quarantine_s"] <= quarantine_gate_s
+        ),
+        "sdc_quarantined": storm["sdc_quarantined"],
+        "zero_client_failures": storm["client_failures"] == 0,
+        "exposure_window_closes": storm["wrong_after_settle"] == 0,
+        "corrupt_weights_never_serves": bool(cw["ok"]),
+        "corrupt_compile_cache_never_serves": bool(cc["ok"]),
+        "zero_false_positive_quarantines": bool(fp["ok"]),
+        "overhead_under_1pct": ovh["delta_pct"] < overhead_gate_pct,
+    }
+    passed = all(gates.values())
+    ttq = storm["time_to_quarantine_s"]
+    ttq_value = ttq if ttq is not None else args.integrity_storm_s
+    print(
+        f"# integrity-drill: 1 of {n_replicas} verified replicas turned "
+        f"silently-corrupt mid-load ({storm['requests']} reqs, "
+        f"concurrency {concurrency}, quorum {quorum_pct:.0f}%): "
+        f"time-to-quarantine "
+        f"{'%.2f s' % ttq if ttq is not None else 'NONE'} (gate "
+        f"{quarantine_gate_s:.0f} s), wrong answers {storm['wrong_answers']}"
+        f" total / {storm['wrong_after_settle']} after settle (gate 0), "
+        f"failures {storm['client_failures']}; corrupt-weights row "
+        f"{'PASS' if cw['ok'] else 'FAIL'} (exit-86 {cw['exits_86']}, "
+        f"served {cw['corrupt_served']}), corrupt-compile-cache row "
+        f"{'PASS' if cc['ok'] else 'FAIL'} (exit-86 {cc['exits_86']}, "
+        f"served {cc['corrupt_served']}), false-positive row "
+        f"{'PASS' if fp['ok'] else 'FAIL'} (quarantines "
+        f"{fp['quarantines']}); unloaded integrity-plane overhead "
+        f"{ovh['delta_pct']:+.2f}% p50 (off {ovh['p50_off_ms']:.3f} -> on "
+        f"{ovh['p50_on_ms']:.3f} ms, {ovh['probes']} probes "
+        f"{ovh['attests']} attests {ovh['quorum_samples']} quorum samples) "
+        f"over {len(ovh['paired_deltas_pct'])} paired rounds",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"integrity-drill time-to-quarantine: 1 of {n_replicas} "
+            f"verified stub replicas turned silently-corrupt (sdc=100%, "
+            f"HTTP 200, healthz green) mid-load behind the real "
+            f"router+pool+quorum (gates: quarantine <= "
+            f"{quarantine_gate_s:.0f} s, 0 client failures, 0 wrong "
+            f"answers after settle, corrupt-weights/compile-cache rows "
+            f"never serve, false-positive row 0 quarantines, unloaded "
+            f"overhead < 1% p50)"
+        ),
+        "value": round(float(ttq_value), 3),
+        "unit": "seconds",
+        "vs_baseline": None,
+        "requests": storm["requests"],
+        "client_failures": storm["client_failures"],
+        "wrong_answers": storm["wrong_answers"],
+        "wrong_after_settle": storm["wrong_after_settle"],
+        "quorum_sampled": storm["quorum"]["samples_total"],
+        "quorum_disagreements": storm["quorum"]["disagreements_total"],
+        "quorum_arbitrations": storm["quorum"]["arbitrations_total"],
+        "corrupt_weights_exits_86": cw["exits_86"],
+        "corrupt_weights_served": cw["corrupt_served"],
+        "corrupt_compile_cache_exits_86": cc["exits_86"],
+        "corrupt_compile_cache_served": cc["corrupt_served"],
+        "false_positive_quarantines": fp["quarantines"],
+        "overhead_delta_pct": round(ovh["delta_pct"], 3),
+        "overhead_p50_off_ms": round(ovh["p50_off_ms"], 3),
+        "overhead_p50_on_ms": round(ovh["p50_on_ms"], 3),
+        "overhead_paired_deltas_pct": [
+            round(d, 3) for d in ovh["paired_deltas_pct"]
+        ],
+        "overhead_probes": ovh["probes"],
+        "overhead_attests": ovh["attests"],
+        "gates": gates,
+        "pass": passed,
+    }
+    print(json.dumps(result))
+    return 0 if passed else 1
+
+
 def rollout_drill_bench(args) -> int:
     """Safe deployment plane, measured (ISSUE 15 acceptance): model-free
     stub fleets behind the REAL router + ReplicaPool + FleetAggregator +
@@ -3897,6 +4295,67 @@ def main() -> int:
     )
     parser.add_argument("--gray-overhead-rounds", type=int, default=8)
     parser.add_argument(
+        "--integrity-drill",
+        action="store_true",
+        help="run the output-integrity drill bench instead (CPU ok, "
+        "model-free): 1-of-N verified stub replicas turned silently "
+        "corrupt (wrong answers, HTTP 200, healthz green) mid-load "
+        "behind the real router+pool+quorum; gates time-to-quarantine "
+        "<= 10 s with a closed exposure window and 0 client failures, "
+        "the corrupt-weights/compile-cache never-serve rows, the "
+        "false-positive row (0 quarantines), and the unloaded "
+        "probe+attest+quorum overhead; exits non-zero when any gate "
+        "fails",
+    )
+    parser.add_argument("--integrity-replicas", type=int, default=4)
+    # 20 ms stub service ~ a realistic replica pace (the --fleet-obs
+    # calibration)
+    parser.add_argument("--integrity-service-ms", type=float, default=20.0)
+    parser.add_argument("--integrity-concurrency", type=int, default=8)
+    parser.add_argument(
+        "--integrity-quorum-pct", type=float, default=25.0,
+        help="edge quorum sampling share for the storm and overhead "
+        "phases (production default is conservative; the drill samples "
+        "aggressively so the 10 s quarantine gate has evidence density)",
+    )
+    parser.add_argument("--integrity-baseline-s", type=float, default=2.0)
+    parser.add_argument(
+        "--integrity-storm-s", type=float, default=8.0,
+        help="load window after the silent-corruption flip; the 10 s "
+        "time-to-quarantine gate needs head room inside it",
+    )
+    parser.add_argument(
+        "--integrity-overhead-requests", type=int, default=50,
+        help="sequential requests per overhead slice (the --fleet-obs "
+        "short-slice protocol)",
+    )
+    parser.add_argument(
+        "--integrity-overhead-rounds", type=int, default=12,
+        help="paired off/on rounds; the gate reads the MEDIAN of the "
+        "per-round paired deltas (slice p50 wobbles ±4%% from batching "
+        "phase-lock alone — the --fleet-obs calibration — so more "
+        "short rounds beat fewer long ones)",
+    )
+    parser.add_argument(
+        "--integrity-overhead-interval-s", type=float, default=2.0,
+        help="probe + attestation cadence for the armed overhead slices "
+        "— 15-30x the production defaults (30/60 s), aggressive enough "
+        "that the loop cost is IN the measured delta without "
+        "manufacturing single-replica contention no deployment would "
+        "run (at 0.5 s the probe duty cycle alone is 4%% of every "
+        "replica and the gate measures the synthetic cadence, not the "
+        "plane)",
+    )
+    parser.add_argument(
+        "--integrity-overhead-quorum-pct", type=float, default=5.0,
+        help="quorum sampling share for the armed overhead slices — a "
+        "production-representative rate (the storm phase samples at "
+        "--integrity-quorum-pct for evidence density; at 25%% every "
+        "fourth request fires a duplicate into the same fleet and the "
+        "overhead row measures that duplicate service time, not the "
+        "sampling plane)",
+    )
+    parser.add_argument(
         "--rollout-drill",
         action="store_true",
         help="run the deployment drill bench instead (CPU ok, model-free): "
@@ -4012,6 +4471,8 @@ def main() -> int:
         return fleet_obs_bench(args)
     if args.gray_storm:
         return gray_storm_bench(args)
+    if args.integrity_drill:
+        return integrity_drill_bench(args)
     if args.rollout_drill:
         return rollout_drill_bench(args)
     if args.controller_crash:

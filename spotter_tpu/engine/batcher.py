@@ -232,6 +232,12 @@ class MicroBatcher:
         # its result fanned to every waiter when the primary settles
         self._keyed: dict[str, tuple[asyncio.Future, list[asyncio.Future]]] = {}
         self._lifecycle_tracker = None
+        # verified readiness hook (ISSUE 17): when the serving runtime wires
+        # an integrity recheck, a degraded rebuild must re-prove its outputs
+        # (attest + golden probe) before re-entering READY. The callback
+        # owns the exit-86 path on failure.
+        self.integrity_recheck_cb: Optional[Callable[[str], bool]] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._fatal_fired = False
         self._fatal_traces: list = []
         self._queue: asyncio.Queue = asyncio.Queue(
@@ -272,6 +278,7 @@ class MicroBatcher:
         if self._pump_task is None:
             self._closed = False
             self._draining = False
+            self._loop = asyncio.get_running_loop()
             self.engine.metrics.set_draining(False)
             self._slots = asyncio.Semaphore(self.max_in_flight)
             self._rebuild_lock = asyncio.Lock()
@@ -886,6 +893,17 @@ class MicroBatcher:
                 return False
             self.max_batch = eng.batch_buckets[-1]
             eng.metrics.set_aggregate_bucket(self.max_batch)
+            # verified readiness (ISSUE 17): a rebuilt engine is a restore
+            # path, and restore paths are SDC ingress — re-prove attest +
+            # golden probe before re-entering READY. The callback owns the
+            # exit-86 path on failure, so a False return must NOT cascade
+            # into the fatal(85) exit underneath this rebuild.
+            recheck = self.integrity_recheck_cb
+            if recheck is not None:
+                if tracker is not None:
+                    tracker.mark(lifecycle.VERIFYING)
+                if not await asyncio.to_thread(recheck, "degraded-rebuild"):
+                    return True
             if tracker is not None:
                 tracker.mark(lifecycle.READY)
             logger.warning(

@@ -58,8 +58,15 @@ PREEMPTED_EXIT_CODE = 83
 # "failed" is terminal for a bring-up that raised — the server exits
 # non-zero right after marking it so the supervisor/kubelet restart path
 # (with backoff) takes over instead of the replica serving 503s forever.
+# "verifying" (ISSUE 17) sits between warming and ready: the golden probe
+# and weights attestation must pass before the replica may serve — on cold
+# start, warm compile-cache restore, OOM downgrade, and degraded-dp
+# rebuild alike. A warmup that compiled fine can still answer WRONG
+# (corrupt restore, poisoned compile cache), and readiness is the last
+# gate before clients see those answers.
 LOADING = "loading"
 WARMING = "warming"
+VERIFYING = "verifying"
 READY = "ready"
 FAILED = "failed"
 
@@ -67,6 +74,14 @@ FAILED = "failed"
 # and the supervisor's CRASH_LOOP_EXIT_CODE (84) so logs tell the three
 # apart; the supervisor treats it as a plain crash (exponential backoff).
 BRINGUP_FAILED_EXIT_CODE = 82
+
+# Exit code for a failed integrity verification (ISSUE 17): the replica's
+# golden probe or weights attestation failed — it was about to serve (or
+# WAS serving) wrong answers. Distinct from every other rung because the
+# supervisor's response is unique: COLD restart with the suspect
+# compile-cache dir quarantined, since a warm restart would faithfully
+# restore the very state that just failed verification.
+INTEGRITY_EXIT_CODE = 86
 
 # Process-start anchor for time_to_ready_s. Module import happens at the top
 # of server bootstrap, so this slightly undercounts interpreter start — the
@@ -132,7 +147,7 @@ class StartupTracker:
         return self._state == READY
 
     def mark(self, state: str) -> None:
-        if state not in (LOADING, WARMING, READY):
+        if state not in (LOADING, WARMING, VERIFYING, READY):
             raise ValueError(f"unknown startup state {state!r}")
         self._state = state
         self._since = time.monotonic()

@@ -303,6 +303,15 @@ class Replica:
     # externally pinned selection weight (rollout canary hold): None =
     # unpinned; combined with the outlier-state weight by taking the min
     pinned_weight: Optional[float] = None
+    # hard quarantine (ISSUE 17): set by the integrity plane when the
+    # replica's answers disagree with the quorum. Unlike gray soft
+    # ejection (a 5% trickle so latency can recover), quarantine is
+    # ABSOLUTE — zero weight, no canary trickle, no health-loop
+    # restoration — because a wrong answer served is a wrong answer a
+    # client acted on. Only an explicit unquarantine (operator, or the
+    # replica's verified post-86 restart) lifts it.
+    quarantined: bool = False
+    quarantine_reason: str = ""
     # diagnostics
     requests: int = 0
     failures: int = 0
@@ -311,7 +320,9 @@ class Replica:
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
     def available(self, now: float) -> bool:
-        return self.healthy and now >= self.ejected_until
+        return (
+            self.healthy and not self.quarantined and now >= self.ejected_until
+        )
 
 
 def _median(values: list[float]) -> Optional[float]:
@@ -418,6 +429,9 @@ class ReplicaPool:
         # mixed-version request pinning (ISSUE 15)
         self.version_pinned_replays_total = 0
         self.version_pin_relaxed_total = 0
+        # hard quarantine (ISSUE 17)
+        self.quarantines_total = 0
+        self.quarantines_refused_total = 0
 
     def _new_replica(self, url: str, healthy: bool = True) -> Replica:
         r = Replica(url=url, healthy=healthy)
@@ -477,6 +491,60 @@ class ReplicaPool:
     def has_available(self) -> bool:
         now = time.monotonic()
         return any(r.available(now) for r in self.replicas)
+
+    # ---- hard quarantine (ISSUE 17 output-integrity plane) ----
+
+    def quarantine(self, url: str, reason: str = "") -> bool:
+        """Hard-quarantine a replica: out of the ring at ZERO weight —
+        primaries, replays, hedges, affinity preferences and quorum
+        witnessing all stop immediately (`available()` is the single
+        gate they share). Refused (False, counted) for an unknown or
+        already-quarantined url, and for the LAST available replica:
+        quarantining the whole fleet turns "some wrong answers" into
+        "no answers at all", which is an operator decision, not an
+        automated one."""
+        r = self.replica_for(url)
+        if r is None or r.quarantined:
+            self.quarantines_refused_total += 1
+            return False
+        now = time.monotonic()
+        peers = sum(
+            1 for o in self.replicas if o is not r and o.available(now)
+        )
+        if peers < 1:
+            self.quarantines_refused_total += 1
+            logger.error(
+                "REFUSING to quarantine %s (%s): it is the last available "
+                "replica — operator attention required", url, reason,
+            )
+            return False
+        r.quarantined = True
+        r.quarantine_reason = reason
+        self.quarantines_total += 1
+        logger.error(
+            "replica %s HARD-QUARANTINED (zero weight, no trickle): %s",
+            url, reason,
+        )
+        return True
+
+    def unquarantine(self, url: str) -> bool:
+        """Lift a quarantine (operator path, or a replica readmitted
+        after its post-86 restart passed verified readiness)."""
+        r = self.replica_for(url)
+        if r is None or not r.quarantined:
+            return False
+        r.quarantined = False
+        r.quarantine_reason = ""
+        logger.warning("replica %s quarantine lifted", url)
+        return True
+
+    def pick_other(self, exclude=()) -> Optional[str]:
+        """Public witness selection for the integrity quorum sampler: the
+        next ranked AVAILABLE replica outside `exclude`, through the same
+        smooth-WRR the primary path uses (so dual-dispatch load spreads
+        and a thinned gray replica witnesses proportionally less)."""
+        r = self._pick({u.rstrip("/") for u in exclude})
+        return r.url if r is not None else None
 
     # ---- lifecycle ----
 
@@ -1054,6 +1122,8 @@ class ReplicaPool:
             "pool_retry_budget_exhausted_total": self.retry_budget.exhausted_total,
             "pool_version_pinned_replays_total": self.version_pinned_replays_total,
             "pool_version_pin_relaxed_total": self.version_pin_relaxed_total,
+            "pool_quarantines_total": self.quarantines_total,
+            "pool_quarantines_refused_total": self.quarantines_refused_total,
             "retry_budget": self.retry_budget.snapshot(),
             "hedge": {
                 "adaptive": self.adaptive_hedge,
@@ -1082,6 +1152,8 @@ class ReplicaPool:
                     "ejections": r.ejections,
                     "outlier_state": r.outlier_state,
                     "outlier_score": round(r.outlier_score, 3),
+                    "quarantined": r.quarantined,
+                    "quarantine_reason": r.quarantine_reason,
                     "weight": self._weight(r),
                     "version": r.version,
                     "pinned_weight": r.pinned_weight,

@@ -63,6 +63,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from spotter_tpu.obs import compare
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.serving.replica_pool import ReplicaPool
 
@@ -157,21 +158,10 @@ async def _shutdown_handle(handle) -> None:
         await res
 
 
-def _norm_detections(images) -> list:
-    """Canonical per-image detection view for shadow comparison: sorted
-    (label, 2dp-score) pairs — stable under detection ordering and float
-    noise, sensitive to the model actually answering differently."""
-    out = []
-    for img in images or []:
-        dets = img.get("detections") if isinstance(img, dict) else None
-        out.append(
-            sorted(
-                (str(d.get("label")), round(float(d.get("score", 0.0)), 2))
-                for d in (dets or [])
-                if isinstance(d, dict)
-            )
-        )
-    return out
+# The detection-diff definition moved to obs/compare.py (ISSUE 17) so the
+# shadow verdict and the router's integrity quorum sampler judge "same
+# answer" identically; re-exported under the old name for existing callers.
+_norm_detections = compare.norm_detections
 
 
 class ShadowLane:

@@ -79,6 +79,7 @@ from spotter_tpu.serving.fleet import (
     classify_request,
     retry_after_header,
 )
+from spotter_tpu.serving.integrity import QuorumSampler
 from spotter_tpu.serving.overload import (
     AdaptiveLimiter,
     edge_limiter_from_env,
@@ -144,6 +145,7 @@ def make_router_app(
     aggregator: FleetAggregator | None = None,
     rollout=None,
     reconciler=None,
+    quorum: QuorumSampler | None = None,
 ) -> web.Application:
     """`limiter` (default: `SPOTTER_TPU_ADMIT_EDGE_TARGET_MS` via
     `edge_limiter_from_env`, None = off) adds the ISSUE 8 AIMD edge gate:
@@ -190,6 +192,9 @@ def make_router_app(
     app["edge_negative"] = negcache
     app["fleet_aggregator"] = aggregator
     app["rollout"] = rollout
+    if quorum is None:
+        quorum = QuorumSampler(pool)  # inert at the default 0% sample
+    app["quorum"] = quorum
     # Edge SLO burn-rate (ISSUE 10): the device plane's burn windows,
     # measured at the edge over what CLIENTS saw — sheds (429/503) and
     # downstream 5xx spend the budget; everything else is good. This is
@@ -289,12 +294,18 @@ def make_router_app(
             else:
                 aff_stats["fallback_total"] += 1
 
+    def _base_url(resp) -> str:
+        """Replica base URL a sub-response came from (quorum attribution)."""
+        return str(resp.url).rsplit("/detect", 1)[0].rstrip("/")
+
     async def _forward_affinity(
         urls: list[str], payload: dict, headers: dict, client_frame: bool
-    ) -> tuple[web.Response, list]:
+    ) -> tuple[web.Response, list, str | None]:
         """Fan-out/fan-in: group URLs by ring owner, forward each group with
         the ring's weight ordering as the failover preference, reassemble
-        in request order. Returns (response, downstream headers list)."""
+        in request order. Returns (response, downstream headers list,
+        primary replica URL when exactly ONE replica served the whole
+        request — the only shape quorum sampling can attribute)."""
         ring = ring_for_pool()
         slots: list[dict | None] = [None] * len(urls)
         x_cache_vals: list[str | None] = []
@@ -358,7 +369,11 @@ def make_router_app(
                 if ver and ver not in versions:
                     versions.append(ver)
                 if len(groups) == 1 and not edge_answered:
-                    return _passthrough(resp, client_frame), downstream
+                    return (
+                        _passthrough(resp, client_frame),
+                        downstream,
+                        _base_url(resp),
+                    )
                 if resp.status_code != 200:
                     # a split request can't merge a replica error body;
                     # surface the first one as a gateway failure
@@ -412,7 +427,7 @@ def make_router_app(
             # >1-entry value IS the mixed-version-window signal
             out.headers[wire.VERSION_HEADER] = ",".join(versions)
         _record_response(len(body), client_frame)
-        return out, downstream
+        return out, downstream, None
 
     async def detect(request: web.Request) -> web.Response:
         # Edge half of the trace (ISSUE 7): mint/continue the ids, forward
@@ -465,9 +480,10 @@ def make_router_app(
         )
         t_fwd = time.monotonic()
         downstream: list = []
+        primary_url: str | None = None
         try:
             if splittable:
-                out, downstream = await _forward_affinity(
+                out, downstream, primary_url = await _forward_affinity(
                     urls, payload, headers, client_frame
                 )
             else:
@@ -478,6 +494,7 @@ def make_router_app(
                 downstream = [resp.headers]
                 _absorb_sub("", resp)
                 out = _passthrough(resp, client_frame)
+                primary_url = _base_url(resp)
         except PoolExhaustedError as exc:
             return done(
                 web.json_response(
@@ -526,6 +543,19 @@ def make_router_app(
             and not client_frame
         ):
             rollout.maybe_shadow(payload, out.body)
+        # quorum sampling (ISSUE 17): re-ask this already-served request of
+        # a SECOND ranked replica and compare — fire-and-forget like the
+        # shadow lane, so disagreement detection never adds client latency.
+        # Only single-replica-served JSON responses are attributable.
+        if (
+            out.status == 200
+            and not client_frame
+            and primary_url
+            and quorum.take()
+        ):
+            asyncio.ensure_future(
+                quorum.run_one(pool.client, payload, out.body, primary_url)
+            )
         return done(out)
 
     async def healthz(request: web.Request) -> web.Response:
@@ -549,6 +579,9 @@ def make_router_app(
                 # edge error-budget state (ISSUE 10): same block shape as
                 # the replica's /healthz slo_burn
                 "slo_burn": slo_burn.block(),
+                # output-integrity plane config (ISSUE 17): sampling share
+                # auditable per edge; 0 = quorum comparison off
+                "quorum_pct": quorum.pct,
                 # control plane (ISSUE 16): leadership + fencing epoch +
                 # desired-vs-observed drift, same block the fleet app serves
                 **reconcile_mod.healthz_block(reconciler),
@@ -609,6 +642,10 @@ def make_router_app(
         # prom renders reconcile_loops_total, drift{pool=...}, ...
         if reconciler is not None:
             snap["reconcile"] = reconciler.snapshot()
+        # output-integrity plane (ISSUE 17): quorum sample/disagreement/
+        # quarantine counters + per-replica disagreement EWMAs; prom renders
+        # integrity_quorum_disagreements_total, ...
+        snap["integrity"] = {"quorum": quorum.snapshot()}
         return obs_http.metrics_response(request, snap)
 
     app.router.add_post("/detect", detect)

@@ -54,7 +54,7 @@ from aiohttp import web
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.ops import preprocess
-from spotter_tpu.serving import lifecycle, wire
+from spotter_tpu.serving import integrity, lifecycle, wire
 from spotter_tpu.serving.detector import QueriesUnsupportedError
 from spotter_tpu.serving.fleet import classify_request
 from spotter_tpu.serving.resilience import AdmissionError
@@ -115,6 +115,7 @@ def make_app(
     preemption: bool = False,
     bringup_exit_cb=os._exit,
     fatal_exit_cb=os._exit,
+    integrity_exit_cb=os._exit,
 ) -> web.Application:
     """Build the serving app.
 
@@ -134,6 +135,16 @@ def make_app(
     with `fatal_exit_cb` — on a fatal device error at dp=1 the process
     exits `FATAL_ENGINE_EXIT_CODE` (85) for an immediate supervisor warm
     restart instead of serving breaker-open 503s off a dead chip.
+
+    Verified readiness (ISSUE 17): with the integrity plane enabled
+    (`SPOTTER_TPU_INTEGRITY`, default on), bring-up passes through the
+    `verifying` state — on-device weights attestation plus a golden probe
+    through the real batcher must PASS before READY, on cold start and
+    warm compile-cache restore alike, and again after every degraded-dp
+    rebuild. A failure exits `INTEGRITY_EXIT_CODE` (86) via
+    `integrity_exit_cb` so the supervisor cold-restarts with the suspect
+    compile cache quarantined. The injected-detector path (tests) skips
+    verification, exactly like it skips bring-up.
     """
     app = web.Application(client_max_size=64 * 1024 * 1024)
     tracker = lifecycle.StartupTracker()
@@ -198,6 +209,15 @@ def make_app(
         _wire_fault_domain(detector)
         tracker.mark_ready(detector.engine.metrics)
 
+    def _make_integrity_recheck(plane):
+        def recheck(source: str) -> bool:
+            if plane.verify_blocking(source):
+                return True
+            plane.integrity_exit(plane.last_error or source)
+            return False
+
+        return recheck
+
     async def _bring_up(app: web.Application) -> None:
         loop = asyncio.get_running_loop()
         try:
@@ -211,8 +231,42 @@ def make_app(
             det.engine.metrics.set_restarts(lifecycle.restarts_from_env())
             _stamp_identity(det)
             _wire_fault_domain(det)
+            # SDC injection seam (ISSUE 17, chaos only): corrupt the live
+            # weights AFTER load, BEFORE verification — the flipped-bit-
+            # after-restore shape the attestation gate must catch
+            n_corrupt = faults.take_corrupt_weights()
+            if n_corrupt and hasattr(det.engine, "corrupt_weights"):
+                logger.warning(
+                    "FAULT: corrupting %d weight leaves before "
+                    "verification", n_corrupt,
+                )
+                det.engine.corrupt_weights(n_corrupt)
+            plane = None
+            if integrity.integrity_enabled():
+                # verified readiness (ISSUE 17): attest + golden probe must
+                # pass before READY — a warm compile-cache restore is just
+                # as much an SDC ingress as a cold load, so both verify
+                tracker.mark(lifecycle.VERIFYING)
+                plane = integrity.IntegrityPlane(
+                    det.engine, det.batcher, exit_cb=integrity_exit_cb
+                )
+                app["integrity"] = plane
+                source = (
+                    "warm-restore"
+                    if lifecycle.restarts_from_env() > 0
+                    else "cold-start"
+                )
+                if not await plane.verify(source):
+                    tracker.mark_failed(plane.last_error or "integrity")
+                    plane.integrity_exit(plane.last_error or source)
+                    return
+                det.batcher.integrity_recheck_cb = (
+                    _make_integrity_recheck(plane)
+                )
             ttr = tracker.mark_ready(det.engine.metrics)
             logger.info("replica ready in %.1f s", ttr)
+            if plane is not None:
+                await plane.start()
         except asyncio.CancelledError:  # server shutdown mid-bring-up
             raise
         except Exception as exc:
@@ -407,7 +461,13 @@ def make_app(
             )
         # JSON view unchanged for existing consumers; ?format=prometheus or
         # Accept: text/plain selects the text exposition (ISSUE 7)
-        return obs_http.metrics_response(request, det.engine.metrics.snapshot())
+        snap = det.engine.metrics.snapshot()
+        # output-integrity plane (ISSUE 17): verification + probe + attest
+        # counters ride the replica snapshot additively
+        plane = request.app.get("integrity")
+        if plane is not None:
+            snap["integrity"] = plane.snapshot()
+        return obs_http.metrics_response(request, snap)
 
     async def profile(request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of in-flight device work.
@@ -452,6 +512,9 @@ def make_app(
         sampler = app.get("hbm_sampler")
         if sampler is not None:
             sampler.stop()
+        plane = app.get("integrity")
+        if plane is not None:
+            await plane.aclose()
         task = app.get("bringup_task")
         if task is not None and not task.done():
             task.cancel()

@@ -18,6 +18,14 @@ NOT hot-looping when the server crashes at import time. Policy:
   compile cache makes the respawn cheap and the device usually comes back
   healthy after a re-init. Same fast-limit guard as preemption — a chip
   that stays dead must not hot-loop spawn→fatal→exit;
+- `INTEGRITY_EXIT_CODE` (serving/lifecycle.py: weights attestation or
+  golden-probe failure, ISSUE 17) → COLD restart with the persistent
+  compile-cache dir quarantined (renamed aside, preserved for forensics):
+  a warm restart would faithfully restore the exact cached state that just
+  produced wrong answers, so this is the one exit where the cache is
+  suspect by construction. Same fast-limit guard — corruption that
+  survives a cold rebuild (bad checkpoint on disk, bad chip) must not
+  hot-loop;
 - any other exit → restart after exponential backoff (`--backoff-base`,
   doubling to `--backoff-max`); a child that stayed up ≥ `--min-uptime`
   resets the backoff. Backoff waits are FULL-JITTERED by default
@@ -57,7 +65,12 @@ import threading
 import time
 
 from spotter_tpu.engine.errors import FATAL_ENGINE_EXIT_CODE
-from spotter_tpu.serving.lifecycle import PREEMPTED_EXIT_CODE, RESTARTS_ENV
+from spotter_tpu.serving.lifecycle import (
+    COMPILE_CACHE_ENV,
+    INTEGRITY_EXIT_CODE,
+    PREEMPTED_EXIT_CODE,
+    RESTARTS_ENV,
+)
 
 # The jitter knob moved to serving/resilience.py (ISSUE 8 satellite: the
 # same switch now also governs the +-25% Retry-After jitter on 429/503
@@ -77,6 +90,33 @@ DEFAULT_PREEMPT_FAST_LIMIT = 3
 CRASH_LOOP_EXIT_CODE = 84  # distinct from the child's codes and from 83
 
 
+def quarantine_compile_cache() -> str | None:
+    """Move the persistent compile-cache dir aside (ISSUE 17).
+
+    Called before respawning after an integrity exit (86): the cache is
+    the one piece of state a cold restart would otherwise faithfully
+    re-ingest, so it is renamed — never deleted, the quarantined copy IS
+    the forensic artifact — to `<dir>.quarantined.<n>`. The child then
+    recreates the dir empty and recompiles from scratch. Returns the
+    quarantine path, or None when no cache dir is configured/present."""
+    cache_dir = os.environ.get(COMPILE_CACHE_ENV, "").strip()
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return None
+    n = 0
+    while True:
+        target = f"{cache_dir.rstrip(os.sep)}.quarantined.{n}"
+        if not os.path.exists(target):
+            break
+        n += 1
+    try:
+        os.rename(cache_dir, target)
+    except OSError:
+        logger.exception("could not quarantine compile cache %s", cache_dir)
+        return None
+    logger.warning(
+        "quarantined suspect compile cache: %s -> %s", cache_dir, target
+    )
+    return target
 
 class Supervisor:
     def __init__(
@@ -157,6 +197,7 @@ class Supervisor:
         consecutive_fast_crashes = 0
         consecutive_fast_preempts = 0
         consecutive_fast_fatals = 0
+        consecutive_fast_integrity = 0
         code = 0
         while True:
             if self._terminating:
@@ -188,6 +229,7 @@ class Supervisor:
                 # `preempt_fast_limit` consecutive fast exits.
                 consecutive_fast_crashes = 0
                 consecutive_fast_preempts = 0
+                consecutive_fast_integrity = 0
                 if uptime >= self.min_uptime_s:
                     consecutive_fast_fatals = 0
                 else:
@@ -209,6 +251,40 @@ class Supervisor:
                     if self._term_event.wait(wait_s):
                         logger.info("terminated during backoff; exiting %d", code)
                         return code
+            elif code == INTEGRITY_EXIT_CODE:
+                # integrity failure (ISSUE 17): attestation or golden probe
+                # caught wrong outputs. COLD restart — quarantine the
+                # compile-cache dir first, because a warm restart would
+                # faithfully restore the exact state that just failed. The
+                # fast-limit guard catches corruption a cold rebuild cannot
+                # fix (bad checkpoint on disk, bad chip): backoff, don't
+                # hot-loop recompiles.
+                consecutive_fast_crashes = 0
+                consecutive_fast_preempts = 0
+                consecutive_fast_fatals = 0
+                if uptime >= self.min_uptime_s:
+                    consecutive_fast_integrity = 0
+                else:
+                    consecutive_fast_integrity += 1
+                quarantine_compile_cache()
+                if consecutive_fast_integrity <= self.preempt_fast_limit:
+                    logger.warning(
+                        "child failed integrity verification (exit %d); "
+                        "cold restart with compile cache quarantined", code,
+                    )
+                    self._reset_backoff()
+                else:
+                    wait_s = self._bump_backoff()
+                    logger.warning(
+                        "child failed integrity verification (exit %d) %d "
+                        "times under %.1f s uptime — corruption survives "
+                        "cold restarts; restarting in %.2f s",
+                        code, consecutive_fast_integrity, self.min_uptime_s,
+                        wait_s,
+                    )
+                    if self._term_event.wait(wait_s):
+                        logger.info("terminated during backoff; exiting %d", code)
+                        return code
             elif code == PREEMPTED_EXIT_CODE:
                 # drained preemption: the replica is healthy software on
                 # yanked capacity — restart immediately, no backoff debt. But
@@ -218,6 +294,7 @@ class Supervisor:
                 # exits restart for free; after that, normal backoff.
                 consecutive_fast_crashes = 0
                 consecutive_fast_fatals = 0
+                consecutive_fast_integrity = 0
                 if uptime >= self.min_uptime_s:
                     consecutive_fast_preempts = 0
                 else:
@@ -240,6 +317,7 @@ class Supervisor:
             else:
                 consecutive_fast_preempts = 0
                 consecutive_fast_fatals = 0
+                consecutive_fast_integrity = 0
                 if uptime >= self.min_uptime_s:
                     self._reset_backoff()
                     consecutive_fast_crashes = 0

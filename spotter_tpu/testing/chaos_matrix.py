@@ -1098,3 +1098,306 @@ def run_controller_matrix(
     for sc in scenarios if scenarios is not None else CONTROLLER_MATRIX:
         reports.append(run_controller_scenario(sc, workdir))
     return reports
+
+
+# ---------------------------------------------------------------------------
+# output-integrity drills (ISSUE 17)
+
+
+@dataclass
+class IntegrityScenario:
+    """One deterministic silent-data-corruption drill.
+
+    Same in-process topology as the gray matrix — N stub replicas behind
+    the real pool + router — but with the ISSUE 17 integrity plane armed:
+    every replica passes verified readiness (attest + golden probe via a
+    real `IntegrityPlane`) before joining the pool, and the router runs a
+    `QuorumSampler` with drill-fast knobs. Corruption shapes:
+
+    `sdc`: that replica index starts answering plausible garbage for the
+    WHOLE load (the `faults.py sdc=<pct>` seam, scoped by replica id) —
+    the quorum must hard-quarantine it with bounded wrong-answer exposure
+    and zero client failures. `corrupt_weights` / `corrupt_compile_cache`:
+    that replica index is corrupted BEFORE verification (flipped weight
+    leaf / poisoned compile-cache restore) — it must exit 86 at the
+    readiness gate and never serve one request. `gray` + fleet `faults`
+    (flaky): the false-positive row — a slow-but-correct replica plus
+    masked 500s must produce ZERO quarantines.
+
+    Wrong answers are counted exactly: the stub's detections are a
+    deterministic function of input content (ISSUE 17 bugfix), so each
+    URL's honest answer is captured once before any fault is armed and
+    every load response is compared against it with the shared
+    obs/compare.py tolerance comparator."""
+
+    name: str
+    requests: int = 160
+    concurrency: int = 4
+    replicas: int = 4
+    service_ms: float = 2.0
+    sdc: int | None = None
+    corrupt_weights: int | None = None
+    corrupt_compile_cache: int | None = None
+    gray: int | None = None
+    gray_factor: float = 20.0
+    gray_at: float = 0.3
+    faults: dict = field(default_factory=dict)
+    quorum_pct: float = 50.0
+    invariants: dict = field(default_factory=dict)
+
+
+INTEGRITY_MATRIX = [
+    IntegrityScenario(
+        name="sdc-replica",
+        sdc=0,
+        invariants={
+            "client_failures": 0,
+            "sdc_quarantined": True,
+            # bounded exposure: wrong answers stop at the quarantine, so
+            # the count stays well under the corrupt replica's fair share
+            # of the load (~40 of 160)
+            "wrong_answers_lt": 40,
+            "exits_86": 0,
+        },
+    ),
+    IntegrityScenario(
+        name="corrupt-weights",
+        corrupt_weights=1,
+        requests=100,
+        invariants={
+            "client_failures": 0,
+            "exits_86": 1,  # caught at the attestation gate
+            "corrupt_served": 0,  # ... BEFORE a single request
+            "wrong_answers": 0,
+            "quarantines": 0,
+        },
+    ),
+    IntegrityScenario(
+        name="corrupt-compile-cache",
+        corrupt_compile_cache=2,
+        requests=100,
+        invariants={
+            "client_failures": 0,
+            "exits_86": 1,  # attest is clean; the golden probe catches it
+            "corrupt_served": 0,
+            "wrong_answers": 0,
+            "quarantines": 0,
+        },
+    ),
+    IntegrityScenario(
+        name="false-positive-immunity",
+        gray=1,
+        faults={"flaky": 5},
+        requests=140,
+        invariants={
+            # slow-but-correct + masked flaky 500s must charge nothing:
+            # wrong answers quarantine, slowness and transport errors never
+            "client_failures": 0,
+            "quarantines": 0,
+            "exits_86": 0,
+            "wrong_answers": 0,
+        },
+    ),
+]
+
+
+async def run_integrity_scenario(sc: IntegrityScenario) -> dict:
+    """Execute one integrity drill; returns the report dict."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.obs import compare
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving.detector import AmenitiesDetector
+    from spotter_tpu.serving.integrity import IntegrityPlane, QuorumSampler
+    from spotter_tpu.serving.replica_pool import ReplicaPool
+    from spotter_tpu.serving.router import make_router_app
+    from spotter_tpu.serving.standalone import make_app
+    from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+    exits: list[int] = []
+    engines, dets, servers, urls = [], [], [], []
+    corrupt_ids: list[str] = []
+    for i in range(sc.replicas):
+        engine = StubEngine(service_ms=sc.service_ms)
+        engine.metrics.set_identity(replica_id=f"integ-r{i}")
+        det = AmenitiesDetector(
+            engine, MicroBatcher(engine, max_delay_ms=1.0), StubHttpClient()
+        )
+        if sc.corrupt_weights == i:
+            engine.corrupt_weights(1)
+        # verified readiness (the standalone _bring_up gate, run inline):
+        # attest + probe must pass before the replica may join the pool;
+        # a failure is the exit-86 path and the replica never serves
+        plane = IntegrityPlane(
+            det.engine, det.batcher, family="stub",
+            probe_interval_s=0, attest_interval_s=0, exit_cb=exits.append,
+        )
+        if sc.corrupt_compile_cache == i:
+            # poisoned compile-cache restore: the weights attest CLEAN but
+            # the restored executable answers wrong — only the probe sees it
+            with faults.inject(corrupt_compile_cache=1):
+                ok = await plane.verify("warm-restore")
+        else:
+            ok = await plane.verify("cold-start")
+        engines.append(engine)
+        dets.append(det)
+        if not ok:
+            plane.integrity_exit(plane.last_error or "integrity")
+            corrupt_ids.append(engine.metrics.replica_id)
+            continue
+        server = TestServer(make_app(detector=det))
+        await server.start_server()
+        servers.append(server)
+        urls.append(f"http://{server.host}:{server.port}")
+
+    pool = ReplicaPool(urls, health_interval_s=0.05, adaptive_hedge=True)
+    quorum = QuorumSampler(
+        pool,
+        pct=sc.quorum_pct,
+        # drill-fast evidence knobs: same machinery, a ~1 s scenario must
+        # converge. alpha .5 / threshold .6 -> two charged disagreements
+        # past min_samples trip the quarantine.
+        ewma_threshold=0.6,
+        min_samples=3,
+        alpha=0.5,
+    )
+    aggregator = FleetAggregator(lambda: [], interval_s=0.0)
+    router_app = make_router_app(pool, aggregator=aggregator, quorum=quorum)
+
+    plan = dict(sc.faults)
+    if sc.sdc is not None:
+        plan.update(sdc=100, only_replica=f"integ-r{sc.sdc}")
+
+    gray_after = int(sc.requests * sc.gray_at)
+    client_failures = 0
+    wrong_answers = 0
+    corrupt_served = 0
+    statuses: dict[int, int] = {}
+    quarantine_at: int | None = None
+    expected: dict[str, list] = {}
+
+    async with TestClient(TestServer(router_app)) as client:
+        # pin every URL's honest answer BEFORE any fault is armed: the
+        # stub's detections are a deterministic function of input content,
+        # identical on every honest replica
+        for url in URL_CYCLE:
+            resp = await client.post("/detect", json={"image_urls": [url]})
+            body = await resp.json()
+            assert resp.status == 200, (resp.status, body)
+            expected[url] = [
+                img.get("detections") for img in body.get("images", [])
+            ]
+
+        cursor = {"i": 0}
+
+        async def worker() -> None:
+            nonlocal client_failures, wrong_answers, quarantine_at
+            nonlocal corrupt_served
+            while cursor["i"] < sc.requests:
+                i = cursor["i"]
+                cursor["i"] += 1
+                if sc.gray is not None and i == gray_after:
+                    engines[sc.gray].service_s *= sc.gray_factor
+                url = URL_CYCLE[i % len(URL_CYCLE)]
+                resp = await client.post(
+                    "/detect", json={"image_urls": [url]}
+                )
+                statuses[resp.status] = statuses.get(resp.status, 0) + 1
+                # a replica that failed verification must never answer:
+                # the identity header names who served every response
+                served_by = resp.headers.get("X-Spotter-Replica", "")
+                if served_by and any(
+                    cid in served_by.split(",") for cid in corrupt_ids
+                ):
+                    corrupt_served += 1
+                if resp.status != 200:
+                    client_failures += 1
+                    await resp.read()
+                    continue
+                body = await resp.json()
+                got = [
+                    img.get("detections")
+                    for img in body.get("images", [])
+                ]
+                if not compare.images_equivalent(expected[url], got):
+                    wrong_answers += 1
+                if (
+                    quarantine_at is None
+                    and pool.quarantines_total > 0
+                ):
+                    quarantine_at = i
+
+        with faults.inject(**plan):
+            await asyncio.gather(*(worker() for _ in range(sc.concurrency)))
+            # let in-flight fire-and-forget quorum samples settle
+            await asyncio.sleep(0.1)
+
+        snap = pool.snapshot()
+        qsnap = quorum.snapshot()
+
+    for server in servers:
+        await server.close()
+    for det in dets:
+        await det.aclose()
+
+    sdc_url = None
+    if sc.sdc is not None and sc.sdc < len(urls):
+        sdc_url = urls[sc.sdc]
+    report = {
+        "name": sc.name,
+        "statuses": statuses,
+        "client_failures": client_failures,
+        "wrong_answers": wrong_answers,
+        "quarantines": snap["pool_quarantines_total"],
+        "exits_86": exits.count(86),
+        "exits": exits,
+        "corrupt_served": corrupt_served,
+        "quarantine_at": quarantine_at,
+        "sdc_quarantined": bool(
+            sdc_url is not None
+            and any(
+                r["url"] == sdc_url and r.get("quarantined")
+                for r in snap["replicas"]
+            )
+        ),
+        "quorum": qsnap,
+        "replica_snapshots": snap["replicas"],
+    }
+    report["checks"] = evaluate_integrity(sc, report)
+    report["ok"] = all(report["checks"].values())
+    return report
+
+
+def evaluate_integrity(sc: IntegrityScenario, report: dict) -> dict:
+    """Invariant name -> bool, same contract as `evaluate`."""
+    checks: dict[str, bool] = {}
+    for key, want in sc.invariants.items():
+        if key == "client_failures":
+            checks[key] = report["client_failures"] == want
+        elif key == "wrong_answers":
+            checks[key] = report["wrong_answers"] == want
+        elif key == "wrong_answers_lt":
+            checks[key] = report["wrong_answers"] < want
+        elif key == "quarantines":
+            checks[key] = report["quarantines"] == want
+        elif key == "sdc_quarantined":
+            checks[key] = report["sdc_quarantined"] == want
+        elif key == "exits_86":
+            checks[key] = report["exits_86"] == want
+        elif key == "corrupt_served":
+            checks[key] = report["corrupt_served"] == want
+        else:
+            raise ValueError(f"unknown invariant {key!r} in {sc.name}")
+    return checks
+
+
+def run_integrity_matrix(
+    scenarios: list[IntegrityScenario] | None = None,
+) -> list[dict]:
+    """Run every integrity drill (fresh event loop each); returns the
+    reports — same contract as `run_matrix`."""
+    reports = []
+    for sc in scenarios if scenarios is not None else INTEGRITY_MATRIX:
+        reports.append(asyncio.run(run_integrity_scenario(sc)))
+    return reports

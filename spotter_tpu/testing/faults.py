@@ -92,6 +92,25 @@ chaos matrix (CONTROLLER_MATRIX) composes:
   of its own state journal on disk (`take_journal_corrupt()`), so the
   NEXT controller's load fails the CRC and must take the counted
   rebuild-from-observation path instead of replaying damaged intent.
+
+The output-integrity tier (ISSUE 17) adds the three silent-data-corruption
+shapes the INTEGRITY_MATRIX and `bench.py --integrity-drill` compose:
+
+- `sdc=<pct>`: that percentage of this replica's engine answers get a
+  deterministic "plausible garbage" perturbation (`corrupt_detections` at
+  the engine-output seam: scores and boxes move far outside the
+  obs/compare.py tolerances, HTTP stays 200) — the silently-wrong replica
+  the router's quorum sampler must hard-quarantine. Bresenham credit like
+  `flaky`, scopable with `only_replica`.
+- `corrupt_weights=<n>`: consumed whole at replica bring-up
+  (`take_corrupt_weights()`), perturbing N loaded "weights" before any
+  traffic — the WeightsAttestor must catch the checksum mismatch in the
+  `verifying` readiness gate, exit 86, never serve.
+- `corrupt_compile_cache=1`: one-shot (`take_corrupt_compile_cache()`),
+  consumed at the golden-probe seam — a miscompiled-program restore:
+  weights attest CLEAN but the probe's observed answer is perturbed, so
+  only the `verifying` probe can catch it (exit 86; the supervisor
+  quarantines the suspect compile-cache dir before the cold restart).
 """
 
 import asyncio
@@ -159,10 +178,18 @@ class FaultPlan:
     # flip-a-journal-byte so the NEXT load must rebuild from observation
     controller_crash: int = 0
     journal_corrupt: int = 0
+    # ISSUE 17 output-integrity tier: percent of engine answers perturbed
+    # into plausible garbage (Bresenham, scopable via only_replica), number
+    # of weights corrupted at bring-up (attestation must catch), and a
+    # one-shot miscompiled-restore arm (golden probe must catch)
+    sdc: int = 0
+    corrupt_weights: int = 0
+    corrupt_compile_cache: int = 0
     # set() to un-wedge hanging engine calls early (tests)
     release: threading.Event = field(default_factory=threading.Event)
     _lock: threading.Lock = field(default_factory=threading.Lock)
     _flaky_credit: int = 0
+    _sdc_credit: int = 0
 
     def _consume(self, attr: str) -> bool:
         with self._lock:
@@ -228,6 +255,9 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "only_replica",
             "controller_crash",
             "journal_corrupt",
+            "sdc",
+            "corrupt_weights",
+            "corrupt_compile_cache",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
         if key == "slow_stage":
@@ -468,6 +498,79 @@ def take_journal_corrupt() -> bool:
     if plan is None:
         return False
     return plan._consume("journal_corrupt")
+
+
+# ---- output-integrity tier (ISSUE 17) ----
+
+
+def perturb_detections(dets: list) -> list:
+    """Deterministic 'plausible garbage': same labels and shapes, scores
+    and boxes moved far outside the obs/compare.py tolerances. This is
+    what silent data corruption looks like from the edge — an HTTP 200
+    with a confident wrong answer — so every integrity seam (sdc,
+    corrupt_compile_cache) perturbs the same way and the drills can
+    assert exact disagreement counts."""
+    out = []
+    for d in dets or []:
+        if isinstance(d, dict):
+            d = dict(d)
+            try:
+                score = float(d.get("score", 0.0))
+            except (TypeError, ValueError):
+                score = 0.0
+            # move the score ~0.17 (>> score_tol) while keeping it a
+            # confident, above-threshold answer — SDC that conveniently
+            # deleted its own detections would be caught by shape alone
+            if score < 0.8:
+                d["score"] = round(min(score + 0.17, 0.99), 4)
+            else:
+                d["score"] = round(max(score - 0.17, 0.01), 4)
+            box = d.get("box")
+            if isinstance(box, (list, tuple)) and len(box) == 4:
+                d["box"] = [float(v) + 17.0 for v in box]
+        out.append(d)
+    return out
+
+
+def corrupt_detections(dets: list, replica_id: str | None = None) -> list:
+    """Engine-output hook: while an `sdc=<pct>` plan is in scope, perturb
+    that share of answers deterministically (Bresenham credit, like
+    `flaky`). Identity when not armed — one None check on the hot path."""
+    plan = _active
+    if plan is None or plan.sdc <= 0 or not _in_scope(plan, replica_id):
+        return dets
+    with plan._lock:
+        plan._sdc_credit += min(plan.sdc, 100)
+        if plan._sdc_credit < 100:
+            return dets
+        plan._sdc_credit -= 100
+    return perturb_detections(dets)
+
+
+def take_corrupt_weights() -> int:
+    """Bring-up hook (serving/standalone.py): consume the whole armed
+    count in one go — corruption landed in the restore, not one flip per
+    request. The caller perturbs that many loaded weights BEFORE the
+    `verifying` gate, which must then fail attestation and exit 86."""
+    plan = _active
+    if plan is None:
+        return 0
+    with plan._lock:
+        n = plan.corrupt_weights
+        plan.corrupt_weights = 0
+    return max(n, 0)
+
+
+def take_corrupt_compile_cache() -> bool:
+    """Golden-probe hook (serving/integrity.py): one-shot miscompiled
+    restore — the probe's OBSERVED answer gets perturbed while weights
+    attest clean, so only the probe can catch it. Consumed once: the
+    respawn (with the quarantined cache dir recompiling from scratch)
+    probes clean."""
+    plan = _active
+    if plan is None:
+        return False
+    return plan._consume("corrupt_compile_cache")
 
 
 def corrupt_frame_bytes(data: bytes, replica_id: str | None = None) -> bytes:

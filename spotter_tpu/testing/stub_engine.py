@@ -27,6 +27,21 @@ STUB_SERVICE_MS_ENV = "SPOTTER_TPU_STUB_SERVICE_MS"
 STUB_DETECTIONS = [{"label": "tv", "score": 0.9, "box": [2.0, 2.0, 20.0, 24.0]}]
 
 
+def content_fingerprint(image) -> int:
+    """Deterministic 16-bit fingerprint of an image's pixel content.
+
+    Raw pixel bytes, not re-encoded JPEG: two in-process decodes of the
+    same fetched bytes must fingerprint identically, and a probe image
+    built directly as a PIL array (serving/integrity.py — never through
+    an encoder) must fingerprint the same everywhere."""
+    try:
+        payload = image.tobytes()
+    except Exception:
+        payload = repr(image).encode()
+    digest = hashlib.blake2b(payload, digest_size=2).digest()
+    return digest[0] | (digest[1] << 8)
+
+
 def stub_image_bytes(w: int = 32, h: int = 32, fill: int = 128) -> bytes:
     import numpy as np
     from PIL import Image
@@ -54,9 +69,13 @@ class StubEngine:
         # `detections` overrides the canned output (ISSUE 15: a "new
         # version" stub whose answers DIFFER is how the shadow lane's
         # detection-diff verdict is exercised model-free)
-        self.detections = (
-            detections if detections is not None else STUB_DETECTIONS
-        )
+        # per-instance deep copy: corrupt_weights() mutates in place, and
+        # aliasing the module-level STUB_DETECTIONS would corrupt every
+        # stub in the process
+        self.detections = [
+            dict(d)
+            for d in (detections if detections is not None else STUB_DETECTIONS)
+        ]
         self.metrics = Metrics()
         # identity stamp (ISSUE 12): stub fleets exercise the same
         # mergeable-snapshot contract the real engine carries, so the
@@ -64,6 +83,57 @@ class StubEngine:
         # the model-free chaos/bench harnesses too
         self.metrics.set_identity(model="stub")
         self.batch_buckets = (1, 2, 4, 8)
+        # Trusted attestation reference (ISSUE 17): captured at load time,
+        # BEFORE any fault can corrupt the live "weights" — the same role
+        # the host-side checkpoint copy plays for the real engine.
+        self._attest_reference = self._checksum()
+
+    def _checksum(self) -> int:
+        digest = hashlib.sha256(repr(self.detections).encode()).digest()
+        return int.from_bytes(digest[:4], "big")
+
+    def attest(self) -> dict:
+        """Same contract as InferenceEngine.attest(): live checksum over
+        whatever the stub would answer with NOW vs the load-time
+        reference — diverges iff something mutated the detections after
+        load (the corrupt_weights fault, a buggy test)."""
+        observed = self._checksum()
+        ok = observed == self._attest_reference
+        return {
+            "ok": ok,
+            "checked": 1,
+            "mismatched": [] if ok else ["stub:0"],
+            "observed": {"stub:0": observed},
+            "expected": {"stub:0": self._attest_reference},
+        }
+
+    def corrupt_weights(self, n: int) -> None:
+        """Test-only SDC injection seam (faults.py corrupt_weights=<n>):
+        perturb the first `n` canned detections the way a flipped weight
+        bit perturbs real outputs — scores move beyond the comparator
+        tolerance and the attestation checksum stops matching."""
+        for det in self.detections[: max(int(n), 0)]:
+            det["score"] = round(
+                min(float(det.get("score", 0.0)) + 0.11, 1.0), 4
+            )
+
+    def _detections_for(self, image) -> list[dict]:
+        h = content_fingerprint(image)
+        d_score = (h % 8) / 100.0
+        d_box = float((h >> 3) % 8)
+        out = []
+        for det in self.detections:
+            d = dict(det)
+            try:
+                score = float(d.get("score", 0.0))
+            except (TypeError, ValueError):
+                score = 0.0
+            d["score"] = round(min(max(score - d_score, 0.0), 1.0), 4)
+            box = d.get("box")
+            if isinstance(box, (list, tuple)) and len(box) == 4:
+                d["box"] = [round(float(v) + d_box, 2) for v in box]
+            out.append(d)
+        return out
 
     def weights_digest(self) -> str:
         """Content fingerprint of this stub's canned output (ISSUE 15):
@@ -103,7 +173,20 @@ class StubEngine:
             time.sleep(self.service_s)
         t_dev = time.monotonic()
         faults.sleep_stage(obs.POSTPROCESS)
-        out = [list(self.detections) for _ in images]
+        # Detections are a deterministic FUNCTION OF INPUT CONTENT
+        # (ISSUE 17 bugfix): the old `list(self.detections)` was
+        # input-independent, so any diff-based test — shadow-lane verdicts,
+        # quorum comparisons, cache-poisoning checks — passed vacuously
+        # (every answer "agreed" because every answer was identical). Now
+        # each image's content hash perturbs score and box inside the
+        # comparator's tolerance-equivalence classes: same input -> same
+        # output on every honest replica with the same weights, different
+        # input -> measurably different output.
+        out = [self._detections_for(img) for img in images]
+        out = [
+            faults.corrupt_detections(dets, self.metrics.replica_id)
+            for dets in out
+        ]
         t_post = time.monotonic()
         stage_windows = [
             (obs.DECODE, t0, t_decode),
