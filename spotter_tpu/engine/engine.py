@@ -40,6 +40,7 @@ from spotter_tpu.ops.postprocess import (
     softmax_postprocess,
     to_detections,
 )
+from spotter_tpu.obs import perf as perf_mod
 from spotter_tpu.obs.perf import sample_hbm_once
 from spotter_tpu.ops.preprocess import (
     DecodePool,
@@ -534,12 +535,17 @@ class InferenceEngine:
         result (failures included) per shape key. `fn` selects the program
         (default the closed-set forward; the open-vocab dispatch passes
         `_forward_q`)."""
-        lo = (fn or self._forward).lower(self.params, *abstract_args)
+        # pallas_call lowers to custom-call HLOs that cost_analysis may
+        # count as 0 FLOPs (or fail on entirely) — collect the kernels'
+        # self-reported analytic FLOPs during the trace and fold them in
+        # (obs/perf.py `combine_flops`: FLOPs honesty, ISSUE 18)
+        with perf_mod.collect_kernel_flops() as noted:
+            lo = (fn or self._forward).lower(self.params, *abstract_args)
         ca = lo.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         flops = ca.get("flops") if hasattr(ca, "get") else None
-        return float(flops) if flops else None
+        return perf_mod.combine_flops(flops, noted.get("__total__"))
 
     def warmup(self) -> None:
         """Compile every bucket ahead of traffic (first compile is slow).

@@ -22,9 +22,12 @@ import numpy as np
 from flax import linen as nn
 
 from spotter_tpu.utils.quant import (
+    int8_attn_wanted,
+    int8_av,
     int8_conv,
     int8_dense,
     int8_dense_wanted,
+    int8_qk,
     int8_wanted,
 )
 
@@ -450,6 +453,32 @@ class ConvKernel(nn.Module):
         )
 
 
+class DenseParams(nn.Module):
+    """The exact param tree of nn.Dense(features, name=...) — `kernel`
+    lecun-normal (in, out) + `bias` zeros — WITHOUT the matmul, returned
+    raw. The ConvNormParams pattern for dense layers: the fused MSDA
+    prologue kernel (models/rtdetr.py / ops/msda.py) consumes the
+    sampling_offsets / attention_weights projection weights directly, and
+    declaring them at nn.Dense's paths keeps checkpoints and converters
+    unaffected."""
+
+    features: int
+    in_features: int
+
+    @nn.compact
+    def __call__(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (self.in_features, self.features),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        return kernel, bias
+
+
 class _BNStats(nn.Module):
     """The four FrozenBatchNorm params at its exact paths, returned folded
     as (mul, add)."""
@@ -550,12 +579,26 @@ class MultiHeadAttention(nn.Module):
             out = out.reshape(*out.shape[:-2], self.embed_dim)
             return proj(out, "out_proj")
 
+        # int8 attention matmuls (SPOTTER_TPU_INT8_ATTN, utils/quant.py):
+        # QK^T and attn·V on the int8 MXU with per-(sample, head) dynamic
+        # scales. batch is static under jit, so the INT8_MIN_BATCH guard
+        # resolves per compiled bucket — the latency-SLO bucket stays bf16.
+        # With the knob unset this branch is never taken and the forward is
+        # bit-identical to the plain einsum path below (test-asserted).
+        quantized = int8_attn_wanted(head_dim, batch=q.shape[0])
+
         # (B, H, Tq, Tk)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        if quantized:
+            logits = int8_qk(q, k)
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
         if attention_mask is not None:
             logits = logits + attention_mask.astype(logits.dtype)
         weights = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        if quantized:
+            out = int8_av(weights, v, self.dtype)
+        else:
+            out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
         out = out.reshape(*out.shape[:-2], self.embed_dim)
         return proj(out, "out_proj")
 

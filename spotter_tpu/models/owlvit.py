@@ -37,6 +37,7 @@ from spotter_tpu.models.layers import (
     QuantDense,
     get_activation,
 )
+from spotter_tpu.ops.openvocab import fused_class_logits, owl_fused_wanted
 
 NEG_INF = float(np.finfo(np.float32).min)
 
@@ -229,12 +230,23 @@ class OwlViTClassHead(nn.Module):
         img_cls = nn.Dense(cfg.text.hidden_size, dtype=self.dtype, name="dense0")(
             image_feats
         )
-        img_cls = img_cls / (jnp.linalg.norm(img_cls, axis=-1, keepdims=True) + 1e-6)
         q = query_embeds / (jnp.linalg.norm(query_embeds, axis=-1, keepdims=True) + 1e-6)
-        logits = jnp.einsum("bpd,qd->bpq", img_cls, q.astype(img_cls.dtype))
-
         shift = nn.Dense(1, dtype=self.dtype, name="logit_shift")(image_feats)
         scale = nn.Dense(1, dtype=self.dtype, name="logit_scale")(image_feats)
+
+        if owl_fused_wanted():
+            # SPOTTER_TPU_OWL_FUSED: patch-normalize + cosine matmul +
+            # shift/elu-scale + NEG_INF query masking in one Pallas kernel
+            # (spotter_tpu/ops/openvocab.py). The three Denses above stay in
+            # XLA; param tree and masking semantics are identical to the
+            # unfused tail below.
+            return fused_class_logits(
+                img_cls, q.astype(jnp.float32), shift[..., 0], scale[..., 0],
+                query_mask,
+            )
+
+        img_cls = img_cls / (jnp.linalg.norm(img_cls, axis=-1, keepdims=True) + 1e-6)
+        logits = jnp.einsum("bpd,qd->bpq", img_cls, q.astype(img_cls.dtype))
         scale = jax.nn.elu(scale) + 1.0
         logits = (logits + shift) * scale
         if query_mask is not None:

@@ -30,6 +30,7 @@ from spotter_tpu.models.configs import RTDetrConfig
 from spotter_tpu.models.layers import (
     ConvNorm,
     ConvNormParams,
+    DenseParams,
     MLPHead,
     MultiHeadAttention,
     get_activation,
@@ -39,7 +40,9 @@ from spotter_tpu.models.layers import (
 from spotter_tpu.models.resnet import ResNetBackbone
 from spotter_tpu.ops.msda import (
     deformable_sampling,
+    deformable_sampling_fused,
     locality_presort,
+    msda_prep_fused,
     presort_wanted,
 )
 from spotter_tpu.ops.topk import top_k as fast_top_k
@@ -229,6 +232,26 @@ class DeformableAttention(nn.Module):
         )
         s = value.shape[1]
         value = value.reshape(b, s, heads, head_dim)
+
+        if msda_prep_fused():
+            # SPOTTER_TPU_MSDA_PREP=fused: the offset/attention projections,
+            # softmax, and location arithmetic run inside the Pallas MSDA
+            # kernel's prologue. DenseParams declares the SAME param paths
+            # (sampling_offsets/attention_weights {kernel, bias}, identical
+            # inits) as the nn.Dense calls below, so checkpoints swap freely
+            # between the fused and unfused paths.
+            w_off, b_off = DenseParams(
+                heads * levels * points * 2, self.d_model, name="sampling_offsets"
+            )()
+            w_att, b_att = DenseParams(
+                heads * levels * points, self.d_model, name="attention_weights"
+            )()
+            out = deformable_sampling_fused(
+                value, hs, reference_points, w_off, b_off, w_att, b_att,
+                spatial_shapes, points, offset_scale=self.offset_scale,
+                method=self.method, presorted=self.presorted,
+            )
+            return nn.Dense(self.d_model, dtype=self.dtype, name="output_proj")(out)
 
         offsets = nn.Dense(
             heads * levels * points * 2, dtype=self.dtype, name="sampling_offsets"

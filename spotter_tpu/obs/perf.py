@@ -128,6 +128,90 @@ def peak_tflops_for(device_kind: str | None) -> float | None:
     return None
 
 
+# --- manual per-kernel FLOP accounting for pallas_call programs ----------
+#
+# XLA's `lower(...).cost_analysis()` can return None or silently count 0
+# FLOPs for custom-call HLOs, which is what every `pallas_call` lowers to —
+# so a forward whose matmuls live in the MSDA / open-vocab kernels would
+# report a near-zero flops_per_image and a fictitious mfu_pct (ISSUE 18
+# satellite: FLOPs honesty). Each kernel dispatcher therefore *notes* its
+# analytic per-call FLOP formula — the same number it hands to
+# `pl.CostEstimate` — at trace time via `note_kernel_flops`; the engine
+# wraps its lowering in `collect_kernel_flops()` and folds the collected
+# total into the cost-analysis number (see `combine_flops`).
+
+_KERNEL_FLOPS_LOCK = threading.Lock()
+_KERNEL_FLOPS_COLLECTORS: list[dict] = []
+
+
+def note_kernel_flops(name: str, flops) -> None:
+    """Record `flops` for one pallas kernel dispatch into every active
+    collector. Called at TRACE time (once per kernel call site per trace);
+    a no-op when nothing is collecting, so steady-state dispatch paths pay
+    one lock acquire and a list check."""
+    try:
+        f = float(flops)
+    except (TypeError, ValueError):
+        return
+    if not math.isfinite(f) or f <= 0:
+        return
+    with _KERNEL_FLOPS_LOCK:
+        for c in _KERNEL_FLOPS_COLLECTORS:
+            c[name] = c.get(name, 0.0) + f
+            c["__total__"] = c.get("__total__", 0.0) + f
+
+
+class collect_kernel_flops:
+    """Context manager: collect `note_kernel_flops` totals emitted while
+    tracing/lowering inside the block. Yields a dict of kernel name ->
+    accumulated FLOPs plus a `__total__` key. Re-entrant and thread-safe
+    (concurrent collectors each see every note — the engine only ever
+    lowers one program per collector)."""
+
+    def __enter__(self):
+        self._c: dict = {}
+        with _KERNEL_FLOPS_LOCK:
+            _KERNEL_FLOPS_COLLECTORS.append(self._c)
+        return self._c
+
+    def __exit__(self, *exc):
+        with _KERNEL_FLOPS_LOCK:
+            try:
+                _KERNEL_FLOPS_COLLECTORS.remove(self._c)
+            except ValueError:
+                pass
+        return False
+
+
+def combine_flops(ca_flops, kernel_flops) -> float | None:
+    """Fold XLA cost-analysis FLOPs with manually-noted pallas FLOPs.
+
+    - cost_analysis missing/zero: the manual total stands alone (None when
+      both are empty — the caller's cache records an honest failure).
+    - cost_analysis present but BELOW the manual total: XLA clearly did not
+      count the custom calls (a program containing a kernel cannot cost
+      less than the kernel) — add the manual total on top.
+    - cost_analysis >= manual total: trust it; some XLA versions do cost
+      custom-call ops via the registered CostEstimate, and adding would
+      double-count.
+    """
+    ca = None
+    try:
+        ca = float(ca_flops) if ca_flops else None
+    except (TypeError, ValueError):
+        ca = None
+    if ca is not None and (not math.isfinite(ca) or ca <= 0):
+        ca = None
+    kf = float(kernel_flops or 0.0)
+    if not math.isfinite(kf) or kf <= 0:
+        kf = 0.0
+    if ca is None:
+        return kf if kf > 0 else None
+    if kf > 0 and ca < kf:
+        return ca + kf
+    return ca
+
+
 class SloBurn:
     """Error-budget burn over per-second good/bad counters.
 

@@ -608,6 +608,7 @@ def pallas_sep_sampling(
         precision=MSDA_MXU_PRECISION,
     )
     flops = 2 * bh * n_bands * (n_qt * pqt * w_lvl * rhd + qp * rhd * hd)
+    _note_flops("msda_sep_band", flops)
     qblock = [
         pl.BlockSpec(
             (1, pqt, 1), lambda i, nq, s, *_: (i, nq, 0), memory_space=pltpu.VMEM
@@ -936,6 +937,7 @@ def pallas_onehot_sampling_merged(
     flops = sum(
         2 * bh * span * (qp * ts * hd + jc * qp * ts) for ts, span in level_tiles
     )
+    _note_flops("msda_onehot_merged", flops)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, n_qt),
@@ -1035,20 +1037,44 @@ pallas_onehot_sampling_merged.defvjp(_onehot_merged_fwd, _onehot_merged_bwd)
 # backward to the kernel. Serving (forward-only) is the intended consumer.
 
 MSDA_PREP = os.environ.get("SPOTTER_TPU_MSDA_PREP", "xla").strip().lower()
-if MSDA_PREP not in ("xla", "kernel"):
-    raise ValueError(f"SPOTTER_TPU_MSDA_PREP must be xla|kernel, got {MSDA_PREP!r}")
-if MSDA_SG and MSDA_PREP == "kernel":
-    # the loc-prep kernel builds plain 0/1 masks (see the SG guard at the
-    # MSDA_SG definition for why silent no-ops are rejected)
+if MSDA_PREP not in ("xla", "kernel", "fused"):
+    raise ValueError(
+        f"SPOTTER_TPU_MSDA_PREP must be xla|kernel|fused, got {MSDA_PREP!r}"
+    )
+if MSDA_SG and MSDA_PREP != "xla":
+    # the loc-prep / fused-prologue kernels build their own hit logic (see
+    # the SG guard at the MSDA_SG definition for why silent no-ops are
+    # rejected)
     raise ValueError(
         "SPOTTER_TPU_MSDA_SG requires SPOTTER_TPU_MSDA_PREP=xla "
-        "(the loc-prep kernel does not implement subgroup hit bits)"
+        "(the loc-prep/fused kernels do not implement subgroup hit bits)"
     )
-if MSDA_NEST and MSDA_PREP == "kernel":
+if MSDA_NEST and MSDA_PREP != "xla":
     raise ValueError(
         "SPOTTER_TPU_MSDA_NEST requires SPOTTER_TPU_MSDA_PREP=xla "
-        "(the loc-prep kernel builds its own corner chains)"
+        "(the loc-prep/fused kernels build their own corner chains)"
     )
+
+
+def msda_prep_fused() -> bool:
+    """True when the model layer should route deformable cross-attention
+    through `deformable_sampling_fused` (SPOTTER_TPU_MSDA_PREP=fused): the
+    sampling-offset / attention-weight projections + softmax + location
+    arithmetic fold into the Pallas kernel's prologue, so the gather-heavy
+    one-hot core runs as fewer, fatter dispatches (ISSUE 18 tentpole).
+    Checked at trace time like the other knobs."""
+    return MSDA_PREP == "fused"
+
+
+def _note_flops(name: str, flops) -> None:
+    """Report this dispatch's analytic FLOPs (the same formula handed to
+    pl.CostEstimate) to the perf ledger's trace-time collector — XLA's
+    cost_analysis counts pallas custom-calls as 0 FLOPs, so without this
+    the MFU attribution under-reports every kernel-path program (ISSUE 18
+    FLOPs honesty). Lazy import: obs must stay importable without jax."""
+    from spotter_tpu.obs.perf import note_kernel_flops
+
+    note_kernel_flops(name, flops)
 
 
 def _onehot_merged_loc_kernel(
@@ -1167,6 +1193,7 @@ def pallas_onehot_sampling_merged_loc(
     flops = sum(
         2 * bh * span * (qp * ts * hd + jc * qp * ts) for ts, span in level_tiles
     )
+    _note_flops("msda_onehot_merged_loc", flops)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, n_qt),
@@ -1222,6 +1249,248 @@ def _loc_bwd(level_tiles, level_dims, n_points, method, interpret, res, g):
 
 
 pallas_onehot_sampling_merged_loc.defvjp(_loc_fwd, _loc_bwd)
+
+
+def _onehot_merged_fused_kernel(
+    hs_ref, woff_ref, boff_ref, watt_ref, batt_ref, base_ref, scale_ref,
+    v_ref, out_ref,
+    *, level_tiles: tuple, level_dims: tuple, n_points: int, method: str,
+    precision,
+):
+    """Fused-prologue variant of `_onehot_merged_loc_kernel`: the sampling-
+    offset and attention-weight projections, the per-head softmax, and the
+    location arithmetic all run in the kernel's prologue, so the op consumes
+    raw decoder hidden states instead of precomputed coords.
+
+    Per grid cell (bh, nq): two small MXU dots against this head's weight
+    slices (hs_tile @ w_off -> offsets, hs_tile @ w_att -> logits), a
+    row-softmax over the LP lanes, xy = base + offs * scale, then the same
+    corner build + one-hot MXU walk as the loc kernel. The per-head split
+    does no redundant projection work — the unfused Dense computes all H
+    heads at once; here each grid cell computes exactly its own head's
+    slice. The hit test is DYNAMIC (computed from the in-kernel corner
+    indices) because sample locations do not exist outside the kernel.
+    """
+    qt = hs_ref.shape[1]
+    lp = watt_ref.shape[2]
+    out_ref[0] = jnp.zeros_like(out_ref[0])
+
+    hs = hs_ref[0].astype(jnp.float32)  # (Q_TILE, D)
+    offs = (
+        jnp.dot(
+            hs, woff_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        + boff_ref[0].astype(jnp.float32)
+    )
+    xy = base_ref[0].astype(jnp.float32) + offs * scale_ref[0].astype(jnp.float32)
+    logits = (
+        jnp.dot(
+            hs, watt_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        + batt_ref[0].astype(jnp.float32)
+    )
+    logits = logits - jnp.max(logits, axis=-1, keepdims=True)
+    e = jnp.exp(logits)
+    at = e / jnp.sum(e, axis=-1, keepdims=True)  # (Q_TILE, LP)
+
+    v_off = 0
+    for lvl, (ts, span) in enumerate(level_tiles):
+        lh, lw = level_dims[lvl]
+        sl = slice(lvl * n_points, (lvl + 1) * n_points)
+        corners = _corner_terms(
+            xy[:, sl],
+            xy[:, lp + lvl * n_points : lp + (lvl + 1) * n_points],
+            at[:, sl],
+            float(lw), float(lh), method,
+        )
+        # dynamic block-sparsity: a source tile is visited only if some
+        # corner of some query in this Q_TILE lands in it (zero-weight
+        # corners excluded — skipping them changes nothing)
+        tiles_of = [jnp.where(wgt > 0, idxc // ts, -1) for idxc, wgt in corners]
+        for k in range(span):
+            hit = tiles_of[0] == k
+            for t in tiles_of[1:]:
+                hit = hit | (t == k)
+
+            @pl.when(jnp.any(hit))
+            def _(k=k, ts=ts, lo=v_off, corners=corners):
+                col = jax.lax.broadcasted_iota(jnp.int32, (qt, ts), 1) + (k * ts)
+                oh = jnp.zeros((qt, ts), jnp.float32)
+                for idxc, wgt in corners:
+                    for p_ in range(idxc.shape[1]):
+                        oh = oh + jnp.where(
+                            col == idxc[:, p_ : p_ + 1], wgt[:, p_ : p_ + 1], 0.0
+                        )
+                acc = jnp.dot(
+                    oh,
+                    v_ref[0, lo + k * ts : lo + (k + 1) * ts].astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                    precision=precision,
+                )
+                out_ref[0] = out_ref[0] + acc.astype(out_ref.dtype)
+
+        v_off += ts * span
+
+
+def _fused_ref(
+    rows, hs, w_off, b_off, w_att, b_att, base, scale,
+    level_tiles, level_dims, n_points, method,
+):
+    """jnp reference of the fused-prologue kernel (VJP + interpret parity):
+    prologue in einsum form, core through `_loc_ref`. rows (BH, s_cat, hd),
+    hs (B, Qp, D), w_off (H, D, 2*LP), b_off (H, 1, 2*LP), w_att (H, D, LP),
+    b_att (H, 1, LP), base/scale (B, Qp, 2*LP) -> (BH, Qp, hd) fp32."""
+    h_axis = w_off.shape[0]
+    b, qp, _ = hs.shape
+    lp = w_att.shape[-1]
+    hs32 = hs.astype(jnp.float32)
+    offs = (
+        jnp.einsum("bqd,hdl->bhql", hs32, w_off.astype(jnp.float32))
+        + b_off.astype(jnp.float32)[None]
+    )
+    xy = base[:, None] + offs * scale[:, None]  # (B, H, Qp, 2*LP)
+    logits = (
+        jnp.einsum("bqd,hdl->bhql", hs32, w_att.astype(jnp.float32))
+        + b_att.astype(jnp.float32)[None]
+    )
+    at = jax.nn.softmax(logits, axis=-1)
+    return _loc_ref(
+        rows,
+        xy.reshape(b * h_axis, qp, 2 * lp),
+        at.reshape(b * h_axis, qp, lp),
+        level_tiles, level_dims, n_points, method,
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
+def pallas_msda_fused(
+    rows, hs, w_off, b_off, w_att, b_att, base, scale,
+    level_tiles: tuple, level_dims: tuple, n_points: int, method: str,
+    interpret: bool = False,
+):
+    """Fused-prologue merged kernel (SPOTTER_TPU_MSDA_PREP=fused).
+
+    rows: (BH, s_cat, hd) per-level-padded concatenation as in the other
+    merged kernels; hs: (B, Qp, D) decoder hidden states (query + pos),
+    zero-padded rows beyond the real query count; w_off/b_off, w_att/b_att:
+    per-head weight slices pre-permuted by `deformable_sampling_fused` into
+    the kernel's x-lanes-then-y-lanes layout; base/scale: (B, Qp, 2*LP)
+    reference-point anchors so xy = base + (hs @ w_off + b_off) * scale.
+    Padded query rows carry zero hs/base/scale: their coords collapse to 0
+    (in-bounds, garbage-but-finite) and their output rows are discarded by
+    the caller's [:, :q] slice; the VJP sees zero cotangent for them.
+    """
+    bh, s_cat, hd = rows.shape
+    b, qp, d = hs.shape
+    h_axis = w_off.shape[0]
+    lp = w_att.shape[-1]
+    level_tiles = tuple((int(t), int(s)) for t, s in level_tiles)
+    level_dims = tuple((int(h), int(w)) for h, w in level_dims)
+    n_qt = qp // Q_TILE
+    assert bh == b * h_axis, (rows.shape, hs.shape, w_off.shape)
+    assert sum(t * s for t, s in level_tiles) == s_cat, (level_tiles, s_cat)
+    kernel = partial(
+        _onehot_merged_fused_kernel,
+        level_tiles=level_tiles,
+        level_dims=level_dims,
+        n_points=n_points,
+        method=method,
+        precision=MSDA_MXU_PRECISION,
+    )
+    jc = (1 if method == "discrete" else 4) * n_points
+    flops = 2 * bh * qp * d * 3 * lp + sum(  # prologue dots + one-hot core
+        2 * bh * span * (qp * ts * hd + jc * qp * ts) for ts, span in level_tiles
+    )
+    _note_flops("msda_fused", flops)
+    h = h_axis  # python int, closed over by the index maps
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, qp, hd), jnp.float32),
+        grid=(bh, n_qt),
+        in_specs=[
+            pl.BlockSpec(
+                (1, Q_TILE, d), lambda i, nq: (i // h, nq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, d, 2 * lp), lambda i, nq: (i % h, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, 2 * lp), lambda i, nq: (i % h, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, d, lp), lambda i, nq: (i % h, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, lp), lambda i, nq: (i % h, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, Q_TILE, 2 * lp), lambda i, nq: (i // h, nq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, Q_TILE, 2 * lp), lambda i, nq: (i // h, nq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, s_cat, hd), lambda i, nq: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, Q_TILE, hd), lambda i, nq: (i, nq, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=(
+                rows.size * 4
+                + hs.size * 4 * h_axis  # each head re-reads the hs tile
+                + (w_off.size + w_att.size) * 4 * n_qt
+                + (base.size + scale.size) * 4 * h_axis
+            ),
+            transcendentals=bh * qp * lp,
+        ),
+        interpret=interpret,
+    )(hs, w_off, b_off, w_att, b_att, base, scale, rows)
+
+
+def _fused_fwd(
+    rows, hs, w_off, b_off, w_att, b_att, base, scale,
+    level_tiles, level_dims, n_points, method, interpret,
+):
+    out = pallas_msda_fused(
+        rows, hs, w_off, b_off, w_att, b_att, base, scale,
+        level_tiles, level_dims, n_points, method, interpret,
+    )
+    return out, (rows, hs, w_off, b_off, w_att, b_att, base, scale)
+
+
+def _fused_bwd(level_tiles, level_dims, n_points, method, interpret, res, g):
+    rows, hs, w_off, b_off, w_att, b_att, base, scale = res
+    _, vjp = jax.vjp(
+        lambda r, q_, wo, bo, wa, ba, bs, sc: _fused_ref(
+            r, q_, wo, bo, wa, ba, bs, sc,
+            level_tiles, level_dims, n_points, method,
+        ),
+        rows, hs, w_off, b_off, w_att, b_att, base, scale,
+    )
+    d_rows, d_hs, d_wo, d_bo, d_wa, d_ba, d_base, d_scale = vjp(g)
+    return (
+        d_rows.astype(rows.dtype), d_hs.astype(hs.dtype),
+        d_wo.astype(w_off.dtype), d_bo.astype(b_off.dtype),
+        d_wa.astype(w_att.dtype), d_ba.astype(b_att.dtype),
+        d_base, d_scale,
+    )
+
+
+pallas_msda_fused.defvjp(_fused_fwd, _fused_bwd)
 
 
 def deformable_sampling(
@@ -1505,4 +1774,125 @@ def deformable_sampling(
     idx, w = corner_idx_w()
     rows = value.transpose(0, 2, 1, 3)  # (B, H, S, hd): row gathers for XLA
     out = _row_gather_weighted_sum(rows, idx, w, lp, q)  # (B, H, Q, hd)
+    return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)
+
+
+def deformable_sampling_fused(
+    value: jnp.ndarray,  # (B, S, H, hd)
+    hs: jnp.ndarray,  # (B, Q, D) decoder hidden states (query + pos embed)
+    reference_points: jnp.ndarray,  # (B, Q, 4) normalized cxcywh
+    w_off: jnp.ndarray,  # (D, H*LP*2) sampling_offsets Dense kernel
+    b_off: jnp.ndarray,  # (H*LP*2,)
+    w_att: jnp.ndarray,  # (D, H*LP) attention_weights Dense kernel
+    b_att: jnp.ndarray,  # (H*LP,)
+    spatial_shapes: tuple[tuple[int, int], ...],
+    num_points: int,
+    offset_scale: float = 0.5,
+    method: str = "default",
+    backend: str | None = None,
+    interpret: bool | None = None,
+    presorted: bool = False,
+) -> jnp.ndarray:
+    """MSDA with the projection/softmax/location prologue fused into the
+    kernel (SPOTTER_TPU_MSDA_PREP=fused): the model layer hands over raw
+    hidden states + the offset/attention Dense params instead of computing
+    offsets and attention weights in XLA. Returns (B, Q, H*hd).
+
+    Weight layout contract: w_off/b_off and w_att/b_att arrive in the plain
+    `nn.Dense` layout (the model declares them via `DenseParams` at the
+    same param paths, so checkpoints are interchangeable with the unfused
+    path); this wrapper pre-permutes them into per-head x-lanes-then-y-lanes
+    slices once per trace — a cheap (D, H*LP*2) shuffle that XLA folds into
+    the weight constant.
+
+    There is no in-op locality sort on this path (sample locations do not
+    exist before the kernel runs): callers that want sorted queries must
+    presort (`presorted=True`, see `presort_wanted`). Non-pallas backends
+    and CPU hosts fall back to the einsum prologue + `deformable_sampling`,
+    which is also the VJP reference — so the fused path keeps the xla
+    bit-parity contract of the other kernel backends.
+    """
+    b, s, h_axis, hd = value.shape
+    q = hs.shape[1]
+    d = hs.shape[2]
+    lp = len(spatial_shapes) * num_points
+
+    # nn.Dense layout -> per-head kernel layout (x lanes then y lanes,
+    # level-major points within each half, matching the loc kernel's xy)
+    w_off_h = (
+        w_off.reshape(d, h_axis, lp, 2)
+        .transpose(1, 0, 3, 2)
+        .reshape(h_axis, d, 2 * lp)
+    )
+    b_off_h = b_off.reshape(h_axis, lp, 2).transpose(0, 2, 1).reshape(h_axis, 1, 2 * lp)
+    w_att_h = w_att.reshape(d, h_axis, lp).transpose(1, 0, 2)
+    b_att_h = b_att.reshape(h_axis, lp)[:, None, :]
+
+    # reference-point anchors: xy = base + offs * scale, per lane
+    ref_xy = reference_points[..., :2].astype(jnp.float32)
+    ref_wh = reference_points[..., 2:].astype(jnp.float32)
+    ps = np.float32(offset_scale / num_points)
+    base = jnp.concatenate(
+        [
+            jnp.broadcast_to(ref_xy[..., 0:1], (b, q, lp)),
+            jnp.broadcast_to(ref_xy[..., 1:2], (b, q, lp)),
+        ],
+        axis=-1,
+    )
+    scale = jnp.concatenate(
+        [
+            jnp.broadcast_to(ref_wh[..., 0:1] * ps, (b, q, lp)),
+            jnp.broadcast_to(ref_wh[..., 1:2] * ps, (b, q, lp)),
+        ],
+        axis=-1,
+    )
+
+    chosen = msda_backend(backend, batch_heads=b * h_axis)
+    if chosen != "pallas":
+        # XLA prologue + whatever core `chosen` names. This branch IS the
+        # reference numerics (`_fused_ref` computes the same einsums).
+        hs32 = hs.astype(jnp.float32)
+        offs = (
+            jnp.einsum("bqd,hdl->bqhl", hs32, w_off_h.astype(jnp.float32))
+            + b_off_h[:, 0][None, None]
+        )
+        xy = base[:, :, None, :] + offs * scale[:, :, None, :]
+        logits = (
+            jnp.einsum("bqd,hdl->bqhl", hs32, w_att_h.astype(jnp.float32))
+            + b_att_h[:, 0][None, None]
+        )
+        attn = jax.nn.softmax(logits, axis=-1)
+        loc = jnp.stack([xy[..., :lp], xy[..., lp:]], axis=-1)
+        return deformable_sampling(
+            value, loc, attn.astype(value.dtype), spatial_shapes, num_points,
+            method=method, backend=backend, interpret=interpret,
+            presorted=presorted,
+        )
+
+    interp = bool(interpret) if interpret is not None else False
+    qp = -(-q // Q_TILE) * Q_TILE
+    hs_p, base_p, scale_p = hs, base, scale
+    if qp != q:  # padded queries: zero hs/base/scale -> discarded rows
+        hs_p = jnp.pad(hs, ((0, 0), (0, qp - q), (0, 0)))
+        base_p = jnp.pad(base, ((0, 0), (0, qp - q), (0, 0)))
+        scale_p = jnp.pad(scale, ((0, 0), (0, qp - q), (0, 0)))
+
+    rows_all = value.transpose(0, 2, 1, 3).reshape(b * h_axis, s, hd)
+    offs_l = _level_offsets(spatial_shapes)
+    rows_cat, tiles = [], []
+    for lvl, (lh, lw) in enumerate(spatial_shapes):
+        ts = S_TILE0 if (lvl == 0 and S_TILE0) else S_TILE
+        s_l = lh * lw
+        rows_l = rows_all[:, offs_l[lvl] : offs_l[lvl] + s_l]
+        s_pad = -(-s_l // ts) * ts
+        if s_pad != s_l:
+            rows_l = jnp.pad(rows_l, ((0, 0), (0, s_pad - s_l), (0, 0)))
+        rows_cat.append(rows_l)
+        tiles.append((ts, s_pad // ts))
+    out = pallas_msda_fused(
+        jnp.concatenate(rows_cat, axis=1),
+        hs_p, w_off_h, b_off_h, w_att_h, b_att_h, base_p, scale_p,
+        tuple(tiles), tuple(spatial_shapes), num_points, method, interp,
+    )
+    out = out[:, :q].reshape(b, h_axis, q, hd)
     return out.transpose(0, 2, 1, 3).reshape(b, q, h_axis * hd)

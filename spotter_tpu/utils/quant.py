@@ -80,6 +80,32 @@ def int8_dense_wanted(in_features: int, batch: int | None = None) -> bool:
     return INT8 and INT8_DENSE and in_features >= INT8_MIN_CH
 
 
+# Attention matmuls (ISSUE 18): QK^T and attn·V are the two activation x
+# activation contractions the conv/dense scheme never touches — no weight
+# tensor, so BOTH operands take dynamic scales. Same "additionally"
+# convention as INT8_DENSE (never active without SPOTTER_TPU_INT8=1), same
+# INT8_MIN_BATCH small-batch guard (the measured batch-4 regression must
+# not leak into the latency-SLO bucket). Scales are per-(sample, head):
+# per-sample for the MicroBatcher batch-independence contract
+# (test_quantize_activation_per_sample_scale), per-head because head
+# activation ranges differ by an order of magnitude post-projection and a
+# shared scale would crush the quiet heads' resolution.
+INT8_ATTN = os.environ.get("SPOTTER_TPU_INT8_ATTN", "0").strip() != "0"
+
+# head_dim floor: QK^T contracts over head_dim, and a head_dim below ~32
+# lanes leaves the MXU contraction too shallow for the quantize/dequant
+# passes to pay off. 32 (not INT8_MIN_CH's 64) so the RT-DETR decoder's
+# 32-dim heads participate by default; `bench.py --int8-ablation` exists to
+# set this floor from data per deployment.
+INT8_ATTN_MIN_HD = int(os.environ.get("SPOTTER_TPU_INT8_ATTN_MIN_HD", "32"))
+
+
+def int8_attn_wanted(head_dim: int, batch: int | None = None) -> bool:
+    if batch is not None and batch < INT8_MIN_BATCH:
+        return False
+    return INT8 and INT8_ATTN and head_dim >= INT8_ATTN_MIN_HD
+
+
 def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(k, k, cin, cout) float -> (int8 kernel, (cout,) f32 scales).
 
@@ -199,6 +225,114 @@ def int8_dense(
     `int8_conv`; the ViT families' qkv/out/fc1/fc2 projections are where
     the matmul FLOPs live (e.g. ~52% of a yolos layer's budget)."""
     return _int8_dense_core(x, kernel).astype(out_dtype)
+
+
+def quantize_per_head(x: jnp.ndarray, head_axis: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Dynamic symmetric int8 with one scale per (sample, head).
+
+    Reduces |x| over every axis except batch (0) and `head_axis`, keeping
+    dims so the scale broadcasts back. Per-sample keeps a served request's
+    grid independent of its batch-mates (the conv-path contract); per-head
+    keeps loud heads from crushing quiet heads' resolution.
+    """
+    xf = x.astype(jnp.float32)
+    axes = tuple(a for a in range(x.ndim) if a not in (0, head_axis % x.ndim))
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    xq = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return xq, scale
+
+
+@jax.custom_vjp
+def _int8_qk_core(q, k):
+    """(B, Tq, H, hd) x (B, Tk, H, hd) -> (B, H, Tq, Tk) fp32 logits.
+
+    Both operands quantized with per-(sample, head) dynamic scales, int8 x
+    int8 -> int32 on the MXU, dequant folded into one fp32 multiply.
+    """
+    qq, sq = quantize_per_head(q, head_axis=2)
+    kq, sk = quantize_per_head(k, head_axis=2)
+    y = jax.lax.dot_general(
+        qq, kq,
+        (((3,), (3,)), ((0, 2), (0, 2))),  # contract hd; batch over (B, H)
+        preferred_element_type=jnp.int32,
+    )  # (B, H, Tq, Tk)
+    # sq/sk arrive (B, 1, H, 1); fold to (B, H, 1, 1) for the output layout
+    s = (sq * sk).transpose(0, 2, 1, 3)
+    return y.astype(jnp.float32) * s
+
+
+def _int8_qk_fwd(q, k):
+    return _int8_qk_core(q, k), (q, k)
+
+
+def _int8_qk_bwd(res, g):
+    # straight-through: the float einsum's gradients (see _int8_conv_bwd)
+    q, k = res
+
+    def float_qk(qq, kk):
+        return jnp.einsum(
+            "bqhd,bkhd->bhqk", qq.astype(jnp.float32), kk.astype(jnp.float32)
+        )
+
+    _, vjp = jax.vjp(float_qk, q, k)
+    dq, dk = vjp(g.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype)
+
+
+_int8_qk_core.defvjp(_int8_qk_fwd, _int8_qk_bwd)
+
+
+@jax.custom_vjp
+def _int8_av_core(w, v):
+    """(B, H, Tq, Tk) softmax weights x (B, Tk, H, hd) -> (B, Tq, H, hd).
+
+    The weights are post-softmax probabilities in [0, 1]; their per-head
+    amax is <= 1 so the int8 grid resolves ~1/127 steps of probability —
+    coarse in absolute terms but weighted by values whose own grid carries
+    the head scale, and gated by the same accuracy tolerance tests as the
+    conv path. int32 accumulation over Tk.
+    """
+    wq, sw = quantize_per_head(w, head_axis=1)
+    vq, sv = quantize_per_head(v, head_axis=2)
+    y = jax.lax.dot_general(
+        wq, vq.transpose(0, 2, 1, 3),  # (B, H, Tk, hd)
+        (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.int32,
+    )  # (B, H, Tq, hd)
+    s = sw * sv.transpose(0, 2, 1, 3)  # (B, H, 1, 1)
+    return (y.astype(jnp.float32) * s).transpose(0, 2, 1, 3)
+
+
+def _int8_av_fwd(w, v):
+    return _int8_av_core(w, v), (w, v)
+
+
+def _int8_av_bwd(res, g):
+    w, v = res
+
+    def float_av(ww, vv):
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", ww.astype(jnp.float32), vv.astype(jnp.float32)
+        )
+
+    _, vjp = jax.vjp(float_av, w, v)
+    dw, dv = vjp(g.astype(jnp.float32))
+    return dw.astype(w.dtype), dv.astype(v.dtype)
+
+
+_int8_av_core.defvjp(_int8_av_fwd, _int8_av_bwd)
+
+
+def int8_qk(q: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Quantized QK^T: drop-in for einsum("bqhd,bkhd->bhqk", q, k), fp32
+    out (the softmax that follows runs fp32 either way). STE backward."""
+    return _int8_qk_core(q, k)
+
+
+def int8_av(w: jnp.ndarray, v: jnp.ndarray, out_dtype: jnp.dtype) -> jnp.ndarray:
+    """Quantized attn·V: drop-in for einsum("bhqk,bkhd->bqhd", w, v)."""
+    return _int8_av_core(w, v).astype(out_dtype)
 
 
 def int8_conv(

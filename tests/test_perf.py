@@ -224,6 +224,45 @@ def test_flops_for_caches_failures():
 
 
 # ---------------------------------------------------------------------------
+# unit: kernel FLOPs ledger (ISSUE 18 — pallas calls cost 0 under XLA)
+
+
+def test_note_kernel_flops_collector_scoping():
+    from spotter_tpu.obs.perf import collect_kernel_flops, note_kernel_flops
+
+    note_kernel_flops("orphan", 123.0)  # no collector active: dropped
+    with collect_kernel_flops() as outer:
+        note_kernel_flops("msda_fused", 100.0)
+        with collect_kernel_flops() as inner:
+            note_kernel_flops("msda_fused", 50.0)
+            note_kernel_flops("owl_class_logits", 7.0)
+        note_kernel_flops("bad", float("nan"))  # rejected
+        note_kernel_flops("bad", -1)  # rejected
+        note_kernel_flops("bad", "x")  # rejected
+    assert inner == {"msda_fused": 50.0, "owl_class_logits": 7.0, "__total__": 57.0}
+    assert outer["msda_fused"] == 150.0 and outer["__total__"] == 157.0
+    assert "bad" not in outer and "orphan" not in outer
+
+
+def test_combine_flops_rules():
+    from spotter_tpu.obs.perf import combine_flops
+
+    # cost_analysis empty -> manual total stands alone (None when both empty)
+    assert combine_flops(None, None) is None
+    assert combine_flops(0, 0.0) is None
+    assert combine_flops(None, 5e6) == 5e6
+    # ca below the manual total: XLA missed the custom calls -> add
+    assert combine_flops(1e6, 5e6) == 6e6
+    # ca at/above the manual total: already counted -> trust ca
+    assert combine_flops(5e6, 5e6) == 5e6
+    assert combine_flops(9e6, 5e6) == 9e6
+    # garbage inputs degrade, never raise
+    assert combine_flops(float("nan"), 5e6) == 5e6
+    assert combine_flops("junk", None) is None
+    assert combine_flops(1e6, float("inf")) == 1e6
+
+
+# ---------------------------------------------------------------------------
 # unit: compile ledger
 
 
