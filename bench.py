@@ -17,6 +17,7 @@ Flags: --model (preset key), --batches (candidate sizes), --iters, --dtype.
 """
 
 import argparse
+import gc
 import json
 import sys
 import time
@@ -2371,6 +2372,490 @@ def integrity_drill_bench(args) -> int:
     return 0 if passed else 1
 
 
+def tenant_storm_bench(args) -> int:
+    """Multi-tenant isolation plane, measured (ISSUE 19 acceptance):
+    model-free stub replicas behind the REAL router + ReplicaPool with a
+    real TenantPlane armed at the edge. Three phases on ONE topology:
+
+    1. **Honest baseline**: 3 honest tenants (slo class, in-quota)
+       closed-loop with no abuser — pins goodput and p99.
+    2. **Noisy-neighbor storm**: the same honest load plus 1 abusive
+       tenant flooding as fast as the loop allows (the faults.py
+       `tenant_flood` seam names the abuser and its multiple; gated to
+       be >= that multiple of quota). Gates: honest goodput >= 95%% of
+       baseline, honest p99 <= 1.5x baseline, ZERO honest slo-class
+       failures, and the abuser's admitted throughput capped at its
+       token-bucket quota (burst + rate x window) within ±10%%.
+    3. **Unconfigured overhead**: tenancy OFF (plane absent — the
+       opt-out discipline) vs ON (configured, in-quota), interleaved
+       paired rounds over one shared replica set (the --fleet-obs
+       protocol). Gate: median paired p50 delta < 1%%.
+
+    Prints ONE JSON line accepted by tools/bench_compare.py; exits
+    non-zero when any gate fails.
+    """
+    import asyncio
+    import random
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving import tenancy
+    from spotter_tpu.serving.detector import AmenitiesDetector
+    from spotter_tpu.serving.fleet import REQUEST_CLASS_HEADER
+    from spotter_tpu.serving.replica_pool import ReplicaPool
+    from spotter_tpu.serving.router import make_router_app
+    from spotter_tpu.serving.standalone import make_app
+    from spotter_tpu.testing import faults
+    from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+    n_replicas = args.tenant_replicas
+    service_ms = args.tenant_service_ms
+    n_honest = args.tenant_honest
+    abuser_rps = args.tenant_rps
+    flood_x = args.tenant_flood_x
+    goodput_gate = 0.95
+    p99_gate_x = 1.5
+    cap_tolerance = 0.10
+    overhead_gate_pct = 1.0
+    honest_names = [f"honest-{i}" for i in range(n_honest)]
+    urls_cycle = [f"http://tenant.example.com/img-{i}.jpg" for i in range(32)]
+
+    async def build_fleet(replica_prefix: str, count: int | None = None):
+        engines, dets, servers, urls = [], [], [], []
+        for i in range(count if count is not None else n_replicas):
+            engine = StubEngine(service_ms=service_ms)
+            engine.metrics.set_identity(replica_id=f"{replica_prefix}{i}")
+            det = AmenitiesDetector(
+                engine,
+                MicroBatcher(engine, max_delay_ms=1.0),
+                StubHttpClient(),
+            )
+            server = TestServer(make_app(detector=det))
+            await server.start_server()
+            engines.append(engine)
+            dets.append(det)
+            servers.append(server)
+            urls.append(f"http://{server.host}:{server.port}")
+        return engines, dets, servers, urls
+
+    async def teardown(dets, servers):
+        for server in servers:
+            await server.close()
+        for det in dets:
+            await det.aclose()
+
+    def make_plane() -> "tenancy.TenantPlane":
+        # the abuser gets a real (small) quota; honest tenants a generous
+        # one they never exhaust — honest sheds would be quota bugs, not
+        # noisy-neighbor protection. Abuser burst = 1 s of quota (tighter
+        # than the 2x default) so the cap gate reads burst + rps x window
+        # with low variance.
+        config = {"abuser": {"rps": abuser_rps, "burst": abuser_rps}}
+        for name in honest_names:
+            config[name] = {"rps": 5000.0}
+        return tenancy.TenantPlane(config=config, rng=random.Random(0))
+
+    async def storm_phases() -> dict:
+        engines, dets, servers, urls = await build_fleet("tenant-bench-r")
+        plane = make_plane()
+        # no adaptive hedging/outlier ejection: the drill reads TENANT
+        # isolation, and an outlier soft-ejection mid-storm would change
+        # pool capacity under the measurement (outlier scoring is ON by
+        # default; in-process event-loop jitter falsely trips it here)
+        pool = ReplicaPool(urls, health_interval_s=0.25, outlier_ratio=0.0)
+        app = make_router_app(
+            pool,
+            aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+            tenancy_plane=plane,
+        )
+        # tenant -> list of (t_send, status, latency_s). SEND-time
+        # attribution: a window owns every request that ARRIVED in it,
+        # however late it completed (drain() awaits all inflight before
+        # the stats are read) — completion-time windows silently drop
+        # the storm's latency tail, biasing the goodput ratio down even
+        # under perfect isolation.
+        events: dict[str, list[tuple[float, int, float]]] = {}
+        stop = {"flag": False}
+
+        async with TestClient(TestServer(app)) as client:
+            inflight: set = set()
+
+            async def one(tenant: str, headers: dict, i: int) -> None:
+                t0 = time.perf_counter()
+                resp = await client.post(
+                    "/detect",
+                    json={
+                        "image_urls": [urls_cycle[i % len(urls_cycle)]]
+                    },
+                    headers=headers,
+                )
+                await resp.read()
+                t1 = time.perf_counter()
+                events.setdefault(tenant, []).append(
+                    (t0, resp.status, t1 - t0)
+                )
+
+            async def open_loop(tenant, headers, rate_hz: float) -> None:
+                """Fixed-rate OPEN-loop arrivals: the offered load does
+                not back off when latency rises, so the goodput ratio
+                reads isolation, not client politeness (a closed loop
+                self-throttles into whatever the server gives it)."""
+                interval = 1.0 / rate_hz
+                i = 0
+                while not stop["flag"]:
+                    task = asyncio.create_task(one(tenant, headers, i))
+                    inflight.add(task)
+                    task.add_done_callback(inflight.discard)
+                    i += 1
+                    await asyncio.sleep(interval)
+
+            def honest_loops():
+                return [
+                    asyncio.create_task(
+                        open_loop(
+                            name,
+                            {
+                                tenancy.TENANT_HEADER: name,
+                                REQUEST_CLASS_HEADER: "slo",
+                            },
+                            args.tenant_honest_rps,
+                        )
+                    )
+                    for name in honest_names
+                ]
+
+            def window(tenant: str, t_from: float, t_to: float):
+                return [
+                    e for e in events.get(tenant, [])
+                    if t_from <= e[0] <= t_to
+                ]
+
+            async def drain(loops) -> None:
+                stop["flag"] = True
+                await asyncio.gather(*loops)
+                await asyncio.gather(*inflight, return_exceptions=True)
+                stop["flag"] = False
+
+            # warm every path (connection setup, first-batch effects)
+            warm = honest_loops()
+            await asyncio.sleep(1.0)
+            await drain(warm)
+            events.clear()
+
+            # phase 1: honest-only baseline (collect first so a pending
+            # GC pause lands in neither measured window)
+            gc.collect()
+            loops = honest_loops()
+            t0 = time.perf_counter()
+            await asyncio.sleep(args.tenant_baseline_s)
+            t1 = time.perf_counter()
+            gc.collect()
+
+            # phase 2: the abuser floods (the faults.py tenant_flood seam
+            # names the abuser + multiple; the storm client IS the fault)
+            with faults.inject(tenant_flood=f"abuser:{flood_x:g}"):
+                flood_tenant, factor = faults.tenant_flood_spec()
+                abuser_before = plane.snapshot()["tenants"].get(
+                    flood_tenant, {}
+                ).get("admits_total", 0)
+                # send ABOVE the gated multiple so the cap gate measures
+                # enforcement, not a lazy client
+                send_hz = (
+                    factor * abuser_rps * args.tenant_abuser_send_margin
+                )
+                loops.append(
+                    asyncio.create_task(
+                        open_loop(
+                            flood_tenant,
+                            {tenancy.TENANT_HEADER: flood_tenant},
+                            send_hz,
+                        )
+                    )
+                )
+                t2 = time.perf_counter()
+                await asyncio.sleep(args.tenant_storm_s)
+                t3 = time.perf_counter()
+                await drain(loops)
+            snap = plane.snapshot()
+
+        await pool.stop()
+        await teardown(dets, servers)
+
+        def honest_stats(t_from: float, t_to: float) -> dict:
+            evs = [
+                e for name in honest_names
+                for e in window(name, t_from, t_to)
+            ]
+            good = [e for e in evs if e[1] == 200]
+            lat = sorted(e[2] for e in good)
+            dur = max(t_to - t_from, 1e-9)
+            return {
+                "requests": len(evs),
+                "failures": len(evs) - len(good),
+                "goodput_rps": len(good) / dur,
+                "p50_ms": (
+                    float(np.percentile([x * 1e3 for x in lat], 50))
+                    if lat else 0.0
+                ),
+                "p99_ms": (
+                    float(np.percentile([x * 1e3 for x in lat], 99))
+                    if lat else 0.0
+                ),
+            }
+
+        base = honest_stats(t0, t1)
+        storm = honest_stats(t2, t3)
+        abuser_events = window("abuser", t2, t3)
+        abuser_sent = len(abuser_events)
+        storm_dur = t3 - t2
+        arow = snap["tenants"].get("abuser", {})
+        abuser_admits = int(arow.get("admits_total", 0)) - int(abuser_before)
+        # the bucket's exact allowance for the window: a full burst at
+        # flood start (the abuser was silent through the baseline) plus
+        # the refill over the measured window
+        quota_allowance = arow.get("burst", 0.0) + abuser_rps * storm_dur
+        return {
+            "baseline": base,
+            "storm": storm,
+            "abuser_sent": abuser_sent,
+            "abuser_send_rps": abuser_sent / storm_dur,
+            "abuser_admits": abuser_admits,
+            "abuser_sheds": int(
+                arow.get("sheds_rate_total", 0)
+                + arow.get("sheds_inflight_total", 0)
+            ),
+            "quota_allowance": quota_allowance,
+            "abuser_cap_err": (
+                abs(abuser_admits - quota_allowance) / quota_allowance
+                if quota_allowance > 0
+                else 1.0
+            ),
+            "storm_s": storm_dur,
+            "plane": snap,
+        }
+
+    async def overhead() -> dict:
+        """Tenancy OFF (plane absent) vs ON (configured, in-quota),
+        paired rounds, ONE shared replica set. OFF is the opt-out
+        discipline: no plane object exists, the serving path is the
+        pre-tenancy code path."""
+        # ONE replica: with several, the two pools' EWMA-fed selection
+        # loops can settle into different routing patterns for a whole
+        # run (observed as a ±2% run-level p50 skew that per-pair
+        # interleaving cannot cancel); a single replica forces both
+        # sides onto the identical serving path, which is the thing
+        # this gate compares
+        engines, dets, servers, urls = await build_fleet(
+            "tenant-ovh-r", count=1
+        )
+        # outlier soft-ejection off (as in the storm pool): the two pools
+        # score the SAME replicas independently, and one side ejecting a
+        # replica the other keeps would skew the paired comparison by a
+        # routing change, not plane cost
+        pool_off = ReplicaPool(
+            urls, health_interval_s=0.25, outlier_ratio=0.0
+        )
+        app_off = make_router_app(
+            pool_off,
+            aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+        )
+        pool_on = ReplicaPool(
+            urls, health_interval_s=0.25, outlier_ratio=0.0
+        )
+        app_on = make_router_app(
+            pool_on,
+            aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+            tenancy_plane=make_plane(),
+        )
+        off: list[float] = []
+        on: list[float] = []
+        paired: list[float] = []
+        # per-pair on-minus-off deltas, split by which side ran FIRST in
+        # the pair: each class's mean is (plane cost ± warmth bias), so
+        # averaging the two class means cancels the warmth term exactly
+        pair_deltas: dict[bool, list[float]] = {False: [], True: []}
+        headers = {tenancy.TENANT_HEADER: honest_names[0]}
+        async with TestClient(TestServer(app_off)) as c_off, TestClient(
+            TestServer(app_on)
+        ) as c_on:
+
+            async def one_request(client, i: int) -> float:
+                t0 = time.perf_counter()
+                resp = await client.post(
+                    "/detect",
+                    json={
+                        "image_urls": [urls_cycle[i % len(urls_cycle)]]
+                    },
+                    headers=headers,
+                )
+                await resp.read()
+                assert resp.status == 200, f"HTTP {resp.status}"
+                return time.perf_counter() - t0
+
+            # warm both paths
+            for i in range(args.tenant_overhead_requests):
+                await one_request(c_off, i)
+                await one_request(c_on, i)
+            for r in range(args.tenant_overhead_rounds):
+                # REQUEST-level interleave, order flipped per PAIR: each
+                # off/on pair runs back-to-back under the same
+                # instantaneous CPU/GC state, and whichever side goes
+                # second (riding the first's replica-side warmth — both
+                # paths share one replica set) alternates every pair, so
+                # the first/second systematic cancels inside each side's
+                # p50. Slice-level interleaving left a ±5% sign-flipping
+                # residue that swamped the µs-scale plane cost this gate
+                # actually measures
+                pair: dict[bool, list[float]] = {False: [], True: []}
+                for i in range(args.tenant_overhead_requests):
+                    order = (
+                        (False, True) if (r + i) % 2 == 0
+                        else (True, False)
+                    )
+                    lat: dict[bool, float] = {}
+                    for armed in order:
+                        lat[armed] = await one_request(
+                            c_on if armed else c_off, i
+                        )
+                    pair[False].append(lat[False])
+                    pair[True].append(lat[True])
+                    # the pair's two requests ran back-to-back under the
+                    # same instantaneous CPU/GC/loop state, so their
+                    # difference isolates the plane cost from drift that
+                    # round-level p50s still pick up; keyed by which side
+                    # went FIRST because the second request rides the
+                    # first's replica-side warmth
+                    pair_deltas[order[0]].append(lat[True] - lat[False])
+                off.extend(pair[False])
+                on.extend(pair[True])
+                off_p50 = float(np.median(pair[False]))
+                on_p50 = float(np.median(pair[True]))
+                if off_p50 > 0:
+                    paired.append((on_p50 - off_p50) / off_p50 * 100.0)
+        await pool_off.stop()
+        await pool_on.stop()
+        await teardown(dets, servers)
+        p50_off = float(np.median(off)) if off else 0.0
+
+        # headline statistic: per order-class trimmed mean of the
+        # per-pair deltas, then the average of the two class means. each
+        # class mean estimates (plane cost ± warmth bias) — whichever
+        # side went second rode the first's replica warmth — so the
+        # average cancels the bias term exactly; trimming inside each
+        # class drops GC-pause outliers without the skew that trimming
+        # the pooled BIMODAL delta distribution introduces. the
+        # median-of-round-p50-deltas this replaced swung ±2% run to run
+        # because each round's p50s sample server-side state the pairing
+        # cannot cancel
+        def _trimmed_mean(xs: list[float]) -> float:
+            trim = len(xs) // 10
+            core = (
+                sorted(xs)[trim: len(xs) - trim]
+                if len(xs) > 2 * trim
+                else xs
+            )
+            return float(np.mean(core)) if core else 0.0
+
+        classes = [v for v in pair_deltas.values() if v]
+        delta_pct = (
+            float(np.mean([_trimmed_mean(v) for v in classes]))
+            / p50_off * 100.0
+            if classes and p50_off > 0
+            else 0.0
+        )
+        return {
+            "p50_off_ms": p50_off * 1e3,
+            "p50_on_ms": float(np.median(on)) * 1e3 if on else 0.0,
+            "paired_deltas_pct": paired,
+            "delta_pct": delta_pct,
+        }
+
+    # overhead first: the paired rounds want the quietest CPU state
+    ovh = asyncio.run(overhead())
+    storm = asyncio.run(storm_phases())
+
+    base = storm["baseline"]
+    under = storm["storm"]
+    goodput_ratio = (
+        under["goodput_rps"] / base["goodput_rps"]
+        if base["goodput_rps"] > 0
+        else 0.0
+    )
+    p99_ratio = (
+        under["p99_ms"] / base["p99_ms"] if base["p99_ms"] > 0 else 0.0
+    )
+    gates = {
+        "honest_goodput_95pct": goodput_ratio >= goodput_gate,
+        "honest_p99_within_1_5x": p99_ratio <= p99_gate_x,
+        "zero_honest_slo_failures": under["failures"] == 0,
+        "abuser_capped_at_quota": storm["abuser_cap_err"] <= cap_tolerance,
+        "abuser_actually_flooded": (
+            storm["abuser_send_rps"] >= flood_x * abuser_rps
+        ),
+        "overhead_under_1pct": ovh["delta_pct"] < overhead_gate_pct,
+    }
+    passed = all(gates.values())
+    print(
+        f"# tenant-storm: 1 abusive + {n_honest} honest tenants over "
+        f"{n_replicas} stub replicas behind the real router+plane: honest "
+        f"goodput {under['goodput_rps']:.0f}/s vs baseline "
+        f"{base['goodput_rps']:.0f}/s ({goodput_ratio * 100:.1f}%, gate "
+        f">= 95%), honest p99 {under['p99_ms']:.1f} vs {base['p99_ms']:.1f}"
+        f" ms ({p99_ratio:.2f}x, gate <= 1.5x), honest slo failures "
+        f"{under['failures']} (gate 0); abuser sent "
+        f"{storm['abuser_send_rps']:.0f}/s (>= {flood_x:g}x quota "
+        f"{abuser_rps:g}/s), admitted {storm['abuser_admits']} vs "
+        f"allowance {storm['quota_allowance']:.0f} "
+        f"({storm['abuser_cap_err'] * 100:+.1f}% err, gate ±10%), shed "
+        f"{storm['abuser_sheds']}; unconfigured-tenancy overhead "
+        f"{ovh['delta_pct']:+.2f}% of p50 (trimmed mean of per-pair "
+        f"deltas; off {ovh['p50_off_ms']:.3f} -> on "
+        f"{ovh['p50_on_ms']:.3f} ms over "
+        f"{len(ovh['paired_deltas_pct'])} paired rounds)",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"tenant-storm honest goodput under abuse: 1 abusive tenant "
+            f"flooding >= {flood_x:g}x its {abuser_rps:g} rps quota next "
+            f"to {n_honest} honest slo-class tenants over {n_replicas} "
+            f"stub replicas behind the real router + TenantPlane (gates: "
+            f"honest goodput >= 95% of no-abuse baseline, honest p99 <= "
+            f"1.5x, 0 honest slo failures, abuser admits within ±10% of "
+            f"its bucket allowance, unconfigured-tenancy overhead < 1% "
+            f"paired p50)"
+        ),
+        "value": round(goodput_ratio * 100.0, 2),
+        "unit": "percent_of_baseline_goodput",
+        "vs_baseline": None,
+        "honest_goodput_baseline_rps": round(base["goodput_rps"], 1),
+        "honest_goodput_storm_rps": round(under["goodput_rps"], 1),
+        "honest_p50_baseline_ms": round(base["p50_ms"], 3),
+        "honest_p50_storm_ms": round(under["p50_ms"], 3),
+        "honest_p99_baseline_ms": round(base["p99_ms"], 3),
+        "honest_p99_storm_ms": round(under["p99_ms"], 3),
+        "honest_p99_ratio": round(p99_ratio, 3),
+        "honest_slo_failures": under["failures"],
+        "abuser_send_rps": round(storm["abuser_send_rps"], 1),
+        "abuser_admits": storm["abuser_admits"],
+        "abuser_sheds": storm["abuser_sheds"],
+        "abuser_quota_allowance": round(storm["quota_allowance"], 1),
+        "abuser_cap_err_pct": round(storm["abuser_cap_err"] * 100.0, 2),
+        "overhead_delta_pct": round(ovh["delta_pct"], 3),
+        "overhead_p50_off_ms": round(ovh["p50_off_ms"], 3),
+        "overhead_p50_on_ms": round(ovh["p50_on_ms"], 3),
+        "overhead_paired_deltas_pct": [
+            round(d, 3) for d in ovh["paired_deltas_pct"]
+        ],
+        "gates": gates,
+        "pass": passed,
+    }
+    print(json.dumps(result))
+    return 0 if passed else 1
+
+
 def rollout_drill_bench(args) -> int:
     """Safe deployment plane, measured (ISSUE 15 acceptance): model-free
     stub fleets behind the REAL router + ReplicaPool + FleetAggregator +
@@ -4506,6 +4991,69 @@ def main() -> int:
         "sampling plane)",
     )
     parser.add_argument(
+        "--tenant-storm",
+        action="store_true",
+        help="run the multi-tenant noisy-neighbor drill bench instead "
+        "(CPU ok, model-free): 1 abusive tenant flooding far past its "
+        "token-bucket quota next to 3 honest slo-class tenants over stub "
+        "replicas behind the real router + TenantPlane; gates honest "
+        "goodput >= 95% of the no-abuse baseline, honest p99 <= 1.5x, 0 "
+        "honest slo failures, the abuser capped at its quota ±10%, and "
+        "the unconfigured-tenancy paired-p50 overhead < 1%; exits "
+        "non-zero when any gate fails",
+    )
+    parser.add_argument("--tenant-replicas", type=int, default=3)
+    # 5 ms stub service: fast enough that the honest closed loop piles up
+    # real throughput for the goodput ratio to be statistically meaningful
+    # inside a short window
+    parser.add_argument("--tenant-service-ms", type=float, default=5.0)
+    parser.add_argument("--tenant-honest", type=int, default=3)
+    parser.add_argument(
+        "--tenant-honest-rps", type=float, default=12.0,
+        help="fixed-rate OPEN-loop arrivals per honest tenant — offered "
+        "load that does not back off under latency, so the goodput gate "
+        "reads isolation, not client politeness; 3 x 12/s keeps the "
+        "single shared event loop (clients, router AND replicas all "
+        "run in-process) well under saturation so latency shifts are "
+        "attributable to the abuser, not loop queueing",
+    )
+    parser.add_argument(
+        "--tenant-rps", type=float, default=2.0,
+        help="the abuser's sustained quota (burst = 1 s of quota); the "
+        "cap gate compares its admits against burst + rps x window; "
+        "kept small so the abuser's SHED traffic (flood-x * margin * "
+        "quota sends/s, each still parsed and 429'd on the shared "
+        "loop) does not saturate the in-process topology",
+    )
+    parser.add_argument(
+        "--tenant-flood-x", type=float, default=8.0,
+        help="flood multiple: the drill asserts the abuser actually SENT "
+        "at >= this multiple of quota, so the cap gate measures "
+        "enforcement, not a lazy client",
+    )
+    parser.add_argument(
+        "--tenant-abuser-send-margin", type=float, default=1.5,
+        help="the abuser's open-loop send rate as a multiple of "
+        "flood-x * quota — headroom above the asserted flood floor",
+    )
+    # long enough that p99 rests on ~300+ samples per window (36 honest
+    # rps x window): 3-4 s windows left p99 riding on the top 2 samples,
+    # which flipped the latency gate on single GC pauses
+    parser.add_argument("--tenant-baseline-s", type=float, default=8.0)
+    parser.add_argument("--tenant-storm-s", type=float, default=10.0)
+    parser.add_argument(
+        "--tenant-overhead-requests", type=int, default=120,
+        help="sequential requests per overhead slice (the --fleet-obs "
+        "short-slice protocol)",
+    )
+    parser.add_argument(
+        "--tenant-overhead-rounds", type=int, default=16,
+        help="paired off/on rounds; the gate reads the MEDIAN of the "
+        "per-round paired deltas (the --fleet-obs calibration); the "
+        "sub-1%% gate needs ~2k pairs for the p50 sampling error of "
+        "each side to drop below the gate width",
+    )
+    parser.add_argument(
         "--rollout-drill",
         action="store_true",
         help="run the deployment drill bench instead (CPU ok, model-free): "
@@ -4625,6 +5173,8 @@ def main() -> int:
         return gray_storm_bench(args)
     if args.integrity_drill:
         return integrity_drill_bench(args)
+    if args.tenant_storm:
+        return tenant_storm_bench(args)
     if args.rollout_drill:
         return rollout_drill_bench(args)
     if args.controller_crash:

@@ -366,6 +366,20 @@ class MicroBatcher:
             "waited_ms": (time.monotonic() - t0) * 1000.0,
         }
 
+    def attach_tenancy(self, plane) -> None:
+        """Wire the tenant isolation plane (ISSUE 19) into every shared-
+        capacity arbiter the batcher owns: the scheduler's within-class DRR
+        ordering, the limiter's top-occupancy-first revocation, and the
+        brownout ladder's per-tenant rung 4. `None` (tenancy unconfigured)
+        leaves all three exactly as built — bit-identical serving."""
+        if plane is None:
+            return
+        self.scheduler.tenancy = plane
+        if self.limiter is not None:
+            self.limiter.tenancy = plane
+        if self.brownout is not None:
+            self.brownout.tenancy = plane
+
     async def submit(
         self,
         image: Image.Image,
@@ -373,6 +387,7 @@ class MicroBatcher:
         key: Optional[str] = None,
         cls: Optional[str] = None,
         qset=None,
+        tenant: Optional[str] = None,
     ) -> list[dict]:
         """One image in, its detections out (awaits the batched device call).
 
@@ -401,6 +416,11 @@ class MicroBatcher:
         scheduler never mixes two query sets into one dispatch, and the
         engine detects the pack against that vocabulary. None keeps the
         closed-set path bit-identical.
+
+        `tenant` (ISSUE 19): the resolved tenant id, stamped into the
+        `QueueItem` so the scheduler's DRR ordering and the limiter's
+        top-occupancy revocation can scope by it. None (tenancy
+        unconfigured) keeps every path bit-identical.
         """
         metrics = self.engine.metrics
         if self.draining:
@@ -425,7 +445,7 @@ class MicroBatcher:
             metrics.record_deadline_exceeded()
             raise deadline.exceeded("queue admission")
         cls = BULK if cls == BULK else SLO
-        adm = self._admit(cls, metrics)
+        adm = self._admit(cls, metrics, tenant)
         fut: asyncio.Future = loop.create_future()
         if adm is not None:
             # release the slot whenever the result lands, however it lands
@@ -458,6 +478,7 @@ class MicroBatcher:
                 adm=adm,
                 cls=cls,
                 key=key,
+                tenant=tenant,
                 qset=qset,
             ))
         except asyncio.QueueFull:
@@ -495,13 +516,16 @@ class MicroBatcher:
         waiters.append(waiter)
         return await self._await_result(waiter, deadline, metrics)
 
-    def _admit(self, cls: str, metrics):
+    def _admit(self, cls: str, metrics, tenant: Optional[str] = None):
         """Overload-control admission (None when the tier is off — the
         static queue-depth put_nowait below stays the only gate, exactly
-        the pre-ISSUE-8 semantics)."""
+        the pre-ISSUE-8 semantics). `tenant` (ISSUE 19) scopes brownout
+        rung 4 (over-share tenants brown out, in-quota tenants keep full
+        service) and tags the limiter admission for top-occupancy-first
+        revocation; None keeps both class-wide."""
         if self.brownout is not None:
             self.brownout.evaluate()
-            if cls == BULK and self.brownout.shed_bulk():
+            if cls == BULK and self.brownout.shed_bulk(tenant):
                 metrics.record_shed()
                 metrics.record_admit_shed(BULK)
                 raise BrownoutShedError(
@@ -513,7 +537,7 @@ class MicroBatcher:
                 )
         if self.limiter is None:
             return None
-        adm = self.limiter.try_admit(cls)
+        adm = self.limiter.try_admit(cls, tenant)
         if adm is None:
             metrics.record_shed()
             raise AdmitLimitError(

@@ -105,6 +105,10 @@ class QueueItem:
     adm: Optional[object] = None  # overload.Admission
     cls: str = SLO
     key: Optional[str] = None
+    # Tenant identity (ISSUE 19): stamped at submit so the scheduler's
+    # deficit-weighted round-robin and the per-tenant SLO accounting know
+    # who each queued image belongs to (None = tenancy unconfigured).
+    tenant: Optional[str] = None
     dims: Optional[tuple[int, int]] = field(default=None, compare=False)
     # Open-vocabulary query set (ISSUE 13): a caching.text_cache.QuerySet.
     # Its `key` is this item's batch-compatibility GROUP — the engine's
@@ -139,7 +143,13 @@ class Scheduler:
         ragged: bool = False,
         step: Optional[int] = None,
         urgent_ms: Optional[float] = None,
+        tenancy=None,
     ) -> None:
+        # Fair scheduling (ISSUE 19): with a serving.tenancy.TenantPlane
+        # attached, within-class ordering becomes deficit-weighted
+        # round-robin across active tenants. None (the default, and every
+        # unconfigured deployment) leaves every code path bit-identical.
+        self.tenancy = tenancy
         self.spec = spec
         self.step = step if step is not None else ragged_step()
         if urgent_ms is None:
@@ -198,6 +208,29 @@ class Scheduler:
             else float("inf")
         )
         return (0 if item.cls == SLO else 1, slack, item.t_submit)
+
+    def _tenant_order(self, items: list) -> list:
+        """DRR across the tenants present in `items` (ISSUE 19). Returns
+        the INPUT LIST itself — not a copy — when tenancy is off or only
+        one tenant is present, so the FIFO bit-identity contract reduces
+        to object identity the tests can assert."""
+        if self.tenancy is None or len(items) <= 1:
+            return items
+        return self.tenancy.drr_order(items, lambda it: it.tenant)
+
+    def _classwise_tenant_order(self, items: list) -> list:
+        """Apply DRR WITHIN each request class: the slo-before-bulk and
+        slack orderings stay structural (overload.py's contract); only the
+        ordering among same-class items of different tenants changes."""
+        if self.tenancy is None:
+            return items
+        slo = [it for it in items if it.cls == SLO]
+        bulk = [it for it in items if it.cls != SLO]
+        o_slo = self._tenant_order(slo)
+        o_bulk = self._tenant_order(bulk)
+        if o_slo is slo and o_bulk is bulk:
+            return items
+        return list(o_slo) + list(o_bulk)
 
     def _full_canvas(self) -> Optional[tuple[int, int]]:
         return self.spec.input_hw if self.spec is not None else None
@@ -289,8 +322,16 @@ class Scheduler:
     ) -> PackPlan:
         """The single-group policy body (see `plan`); mutates `pending`."""
         if self.fifo:
-            pack = pending[:target]
-            del pending[: len(pack)]
+            ordered = self._tenant_order(pending)
+            if ordered is pending:
+                # tenancy off / single tenant: the EXACT pre-ISSUE-19
+                # drain — same statements, same object identities
+                pack = pending[:target]
+                del pending[: len(pack)]
+            else:
+                pack = ordered[:target]
+                chosen = {id(it) for it in pack}
+                pending[:] = [it for it in pending if id(it) not in chosen]
             full = self._full_canvas()
             waste = (
                 self._waste_pct([self.item_dims(it) for it in pack], full)
@@ -301,6 +342,7 @@ class Scheduler:
 
         now = time.monotonic() if now is None else now
         items = sorted(pending, key=lambda it: self.priority_key(it, now))
+        items = self._classwise_tenant_order(items)
 
         if not self.canvas_capable:
             # fixed-canvas spec: slack ordering only, static canvas

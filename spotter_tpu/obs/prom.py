@@ -40,6 +40,10 @@ _LABELED_KEYS = {
     "rollouts_total": ("verdict",),
     # control plane (ISSUE 16): desired-vs-observed gap per pool
     "drift": ("pool",),
+    # tenant isolation plane (ISSUE 19): bounded top-K per-tenant rows —
+    # tenants{tenant="acme",stat="admits_total"} ... cardinality is capped
+    # by the plane's top_k + "other" overflow bucket, never by scrape luck
+    "tenants": ("tenant", "stat"),
 }
 # keys whose dict values are {"p50": x, "p90": y, ...} quantile summaries
 # (the engine snapshot's slack_at_dispatch_ms, ISSUE 9) — rendered as a

@@ -220,6 +220,19 @@ class AmenitiesDetector:
             if text_encoder is not None
             else None
         )
+        # Tenant isolation plane (ISSUE 19): None unless the serving layer
+        # wires one via attach_tenancy() — every tenant-aware branch below
+        # is a no-op then (bit-identical serving).
+        self.tenancy = None
+
+    def attach_tenancy(self, plane) -> None:
+        """Wire the tenant isolation plane (ISSUE 19) through the detector
+        and down into the batcher's arbiters (scheduler DRR, limiter
+        revocation scoping, per-tenant brownout). None is a no-op."""
+        if plane is None:
+            return
+        self.tenancy = plane
+        self.batcher.attach_tenancy(plane)
 
     def _check_fetch_size(self, url: str, nbytes: int) -> None:
         if self.fetch_max_bytes > 0 and nbytes > self.fetch_max_bytes:
@@ -376,6 +389,7 @@ class AmenitiesDetector:
         degraded: set[str] | None = None,
         info: dict | None = None,
         qset=None,
+        tenant: str | None = None,
     ) -> ImageResult:
         # the ambient request trace (ISSUE 7): span capture below is a
         # monotonic read + list append per stage; None (recorder off, or a
@@ -472,7 +486,7 @@ class AmenitiesDetector:
                     )
                 raw_detections = await self.batcher.submit(
                     image, deadline=deadline, key=cache_key, cls=cls,
-                    qset=qset,
+                    qset=qset, tenant=tenant,
                 )
 
             # brownout threshold rung (ISSUE 8): raise the effective
@@ -594,13 +608,17 @@ class AmenitiesDetector:
         deadline: Deadline | None = None,
         cls: str | None = None,
         info: dict | None = None,
+        tenant: str | None = None,
     ) -> DetectionResponse:
         """`info` (ISSUE 11, optional dict) collects per-URL data-plane
         observations for the HTTP layer: `info["cache"]` maps url ->
         hit|miss|negative|coalesced (the X-Cache header) and
         `info["negative"]` carries deterministic-failure verdicts for the
         X-Spotter-Negative header. Pass None (the default) and nothing is
-        collected — the pre-ISSUE-11 path, bit-identical."""
+        collected — the pre-ISSUE-11 path, bit-identical. `tenant`
+        (ISSUE 19) rides into every batcher submit so the scheduler's DRR
+        ordering and the limiter's revocation scoping see it; None keeps
+        the tenant-blind path."""
         request = DetectionRequest.model_validate(payload)
         if deadline is None:
             deadline = Deadline.from_env()
@@ -623,7 +641,8 @@ class AmenitiesDetector:
         degraded: set[str] = set()
         tasks = [
             self._process_single_image(
-                u, deadline, cls=cls, degraded=degraded, info=info, qset=qset
+                u, deadline, cls=cls, degraded=degraded, info=info, qset=qset,
+                tenant=tenant,
             )
             for u in urls
         ]
@@ -665,13 +684,17 @@ class AmenitiesDetector:
             degraded=sorted(degraded) if degraded else None,
         )
 
-    def check_admission(self, cls: str | None = None) -> AdmissionError | None:
+    def check_admission(
+        self, cls: str | None = None, tenant: str | None = None
+    ) -> AdmissionError | None:
         """HTTP-layer fast path: an AdmissionError to answer with (mapped to
         429/503 + Retry-After) before any fetch work, or None to proceed.
         Never consumes the breaker's half-open probe slot — a request that
         could probe must reach `MicroBatcher.submit` to do so. `cls`
         ("slo"|"bulk") lets the deepest brownout rung shed bulk BEFORE the
-        fetch spends bytes on work the batcher would refuse anyway."""
+        fetch spends bytes on work the batcher would refuse anyway;
+        `tenant` (ISSUE 19) scopes that rung so only over-share tenants
+        brown out while in-quota tenants keep full service."""
         if self.batcher.draining:
             self.engine.metrics.record_shed()
             return DrainingError("server draining")
@@ -684,7 +707,7 @@ class AmenitiesDetector:
         brownout = self.batcher.brownout
         if brownout is not None and cls == BULK:
             brownout.evaluate()
-            if brownout.shed_bulk():
+            if brownout.shed_bulk(tenant):
                 self.engine.metrics.record_shed()
                 self.engine.metrics.record_admit_shed(BULK)
                 return BrownoutShedError(
@@ -778,6 +801,12 @@ class AmenitiesDetector:
             # budget burn over deadline misses + sheds — the brownout
             # ladder's effect shows up here as budget recovery
             "slo_burn": self.engine.metrics.perf.slo.block(),
+            # tenant isolation plane (ISSUE 19): quota/fairness state when
+            # configured; absent-as-disabled mirrors the cache block
+            "tenancy": (
+                self.tenancy.snapshot() if self.tenancy is not None
+                else {"enabled": False}
+            ),
         }
 
     async def drain(self, timeout_s: float | None = None) -> dict:

@@ -735,6 +735,7 @@ def make_fleet_app(
     controller: FleetController, limiter=None,
     aggregator: FleetAggregator | None = None,
     reconciler=None,
+    tenancy_plane=None,
 ) -> web.Application:
     """The fleet edge: /detect classifies (header/payload) and routes
     through the controller; /metrics serves the pool gauges the storm bench
@@ -749,13 +750,22 @@ def make_fleet_app(
     `reconciler` (ISSUE 16, default None) attaches a
     `reconcile.Reconciler`: /healthz grows the leadership + drift block
     and /metrics the `reconcile` counters (adoptions, fencing rejections,
-    journal rebuilds, per-pool drift)."""
+    journal rebuilds, per-pool drift). `tenancy_plane` (ISSUE 19, default
+    `tenancy.from_env()` — None when unconfigured) arms per-tenant edge
+    quotas exactly like the plain router: over-quota tenants shed 429
+    with a tenant-scoped Retry-After before the body is read, and the
+    resolved id rides downstream in X-Spotter-Tenant."""
+    from spotter_tpu.serving import tenancy
+
     if aggregator is None:
         aggregator = FleetAggregator(lambda: fleet_member_urls(controller))
+    if tenancy_plane is None:
+        tenancy_plane = tenancy.from_env()
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["fleet"] = controller
     app["edge_limiter"] = limiter
     app["fleet_aggregator"] = aggregator
+    app["tenancy"] = tenancy_plane
 
     async def on_startup(app: web.Application) -> None:
         await controller.start()
@@ -771,12 +781,30 @@ def make_fleet_app(
         # included), traceparent forwarded, replica Server-Timing merged
         # behind a route span that also covers the pool pick.
         trace, request_id = obs_http.begin_http_trace(request)
+        tenant = None
+        tadm = None
 
         def done(resp: web.Response) -> web.Response:
+            # per-tenant occupancy + SLO accounting (ISSUE 19)
+            if tadm is not None:
+                tadm.release(
+                    good=resp.status not in (429, 503) and resp.status < 500
+                )
             return obs_http.finish_http_trace(
                 trace, request_id, resp, server_timing=True
             )
 
+        if tenancy_plane is not None:
+            # edge quota (ISSUE 19): header-only identity, shed 429 before
+            # the body is read — strictly before any in-quota shed below
+            from spotter_tpu.serving import tenancy as tenancy_mod
+            from spotter_tpu.serving.router import tenant_shed_response
+
+            tenant = tenancy_plane.resolve(request.headers)
+            try:
+                tadm = tenancy_plane.try_admit(tenant)
+            except tenancy_mod.TenantQuotaError as exc:
+                return done(tenant_shed_response(exc))
         with obs.span(obs.ROUTE, trace):
             try:
                 payload = await request.json()
@@ -796,6 +824,12 @@ def make_fleet_app(
         # class ordering, brownout bulk rung) sees the same verdict
         headers = obs_http.forward_headers(trace, request_id)
         headers[REQUEST_CLASS_HEADER] = cls
+        if tenant is not None:
+            # resolved tenant id rides downstream alongside X-Request-ID
+            # (ISSUE 19) so the replica scopes by the same identity
+            from spotter_tpu.serving.tenancy import TENANT_HEADER
+
+            headers[TENANT_HEADER] = tenant
         t_fwd = time.monotonic()
         try:
             resp = await controller.request(
@@ -868,9 +902,22 @@ def make_fleet_app(
         # the desired-vs-ready drift gauge, labeled per pool by prom
         if reconciler is not None:
             snap["reconcile"] = reconciler.snapshot()
+        # tenant isolation plane (ISSUE 19): bounded top-K per-tenant rows
+        if tenancy_plane is not None:
+            snap["tenants"] = tenancy_plane.metrics_view()
         return obs_http.metrics_response(request, snap)
 
+    async def debug_tenants(request: web.Request) -> web.Response:
+        """Full per-tenant table (ISSUE 19) — admin-token-gated."""
+        rejected = obs_http.admin_rejection(request)
+        if rejected is not None:
+            return rejected
+        if tenancy_plane is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(tenancy_plane.snapshot())
+
     app.router.add_post("/detect", detect)
+    app.router.add_get("/debug/tenants", debug_tenants)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/livez", livez)
     app.router.add_get("/metrics", metrics)

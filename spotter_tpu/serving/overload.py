@@ -135,10 +135,20 @@ class Admission:
     admission may carry a revoke callback so a later slo arrival can
     reclaim the slot while the work is still queued."""
 
-    __slots__ = ("cls", "_limiter", "_revoke_cb", "_released", "_revocable")
+    __slots__ = (
+        "cls", "tenant", "_limiter", "_revoke_cb", "_released", "_revocable",
+    )
 
-    def __init__(self, limiter: "AdaptiveLimiter", cls: str) -> None:
+    def __init__(
+        self,
+        limiter: "AdaptiveLimiter",
+        cls: str,
+        tenant: Optional[str] = None,
+    ) -> None:
         self.cls = cls
+        # tenant identity (ISSUE 19): lets the revocation path pick the
+        # top-occupancy tenant's bulk first; None when tenancy is off
+        self.tenant = tenant
         self._limiter = limiter
         self._revoke_cb: Optional[Callable[[], None]] = None
         self._released = False
@@ -178,9 +188,14 @@ class AdaptiveLimiter:
         interval_s: float = DEFAULT_ADMIT_INTERVAL_S,
         clock=time.monotonic,
         metrics=None,
+        tenancy=None,
     ) -> None:
         if target_ms <= 0:
             raise ValueError("target_ms must be > 0 (unset disables the tier)")
+        # tenant isolation plane (ISSUE 19): when attached, revocation
+        # prefers the top-occupancy tenant's bulk. None (the default and
+        # every unconfigured deployment) keeps revocation bit-identical.
+        self.tenancy = tenancy
         self.target_ms = target_ms
         self.floor = max(1, int(floor))
         self.ceiling = max(self.floor, int(ceiling))
@@ -297,7 +312,9 @@ class AdaptiveLimiter:
 
     # -- admission --
 
-    def try_admit(self, cls: str = SLO) -> Optional[Admission]:
+    def try_admit(
+        self, cls: str = SLO, tenant: Optional[str] = None
+    ) -> Optional[Admission]:
         """One admission attempt. Returns a slot, or None (shed).
 
         Class order is structural: when the limit is hit, a bulk arrival
@@ -306,29 +323,33 @@ class AdaptiveLimiter:
         still holds a slot (each overage slot is backed by at least one
         bulk slot, so the true engine pressure stays <= limit once bulk
         drains) — slo is shed only when the limit is hit by slo alone.
+        With the tenancy plane attached (ISSUE 19) the revocation victim
+        is the TOP-OCCUPANCY tenant's newest queued bulk, so the flooding
+        tenant pays for the reclaimed slot before anyone else does.
         """
         if cls not in (SLO, BULK):
             cls = SLO
         with self._lock:
             self._maybe_update(self._clock())
             if self._in_flight < self.limit:
-                return self._admit(cls)
+                return self._admit(cls, tenant)
             if cls == BULK:
                 return self._shed(cls)
             victim = self._pop_revocable()
             if victim is not None:
                 self._revoke(victim)
-                return self._admit(cls)
+                return self._admit(cls, tenant)
             if self._bulk_in_flight > 0:
-                return self._admit(cls)  # bounded soft overage (see above)
+                # bounded soft overage (see above)
+                return self._admit(cls, tenant)
             return self._shed(cls)
 
-    def _admit(self, cls: str) -> Admission:
+    def _admit(self, cls: str, tenant: Optional[str] = None) -> Admission:
         # caller holds the lock
         self._in_flight += 1
         if cls == BULK:
             self._bulk_in_flight += 1
-        return Admission(self, cls)
+        return Admission(self, cls, tenant)
 
     def _shed(self, cls: str) -> None:
         # caller holds the lock
@@ -339,12 +360,21 @@ class AdaptiveLimiter:
 
     def _pop_revocable(self) -> Optional[Admission]:
         # caller holds the lock; newest first (LIFO-ish: the freshest bulk
-        # work has waited least and wasted least)
-        while self._revocable:
-            adm = self._revocable.pop()
-            if not adm._released:
-                return adm
-        return None
+        # work has waited least and wasted least). With the tenancy plane
+        # attached (ISSUE 19), the TOP-OCCUPANCY tenant's newest revocable
+        # bulk is preferred — over-share bulk pays before anyone else's —
+        # falling back to plain newest-first when that tenant holds none.
+        self._revocable = [a for a in self._revocable if not a._released]
+        if not self._revocable:
+            return None
+        if self.tenancy is not None:
+            top = self.tenancy.top_occupancy_tenant()
+            if top is not None:
+                for adm in reversed(self._revocable):
+                    if adm.tenant == top:
+                        self._revocable.remove(adm)
+                        return adm
+        return self._revocable.pop()
 
     def _revoke(self, adm: Admission) -> None:
         # caller holds the lock; free the slot NOW (the victim's own
@@ -473,7 +503,12 @@ class BrownoutController:
         metrics=None,
         recorder=None,
         hold: Optional[Callable[[], bool]] = None,
+        tenancy=None,
     ) -> None:
+        # tenant isolation plane (ISSUE 19): when attached, the bulk_503
+        # rung is scoped to OVER-SHARE tenants only; None keeps the
+        # class-wide rung bit-identical.
+        self.tenancy = tenancy
         self.saturated = saturated
         # `hold` (optional): blocks DE-escalation without driving
         # escalation — see saturation_signals for why the asymmetry exists
@@ -494,7 +529,7 @@ class BrownoutController:
 
     @classmethod
     def from_env(
-        cls, limiter: Optional[AdaptiveLimiter], metrics=None
+        cls, limiter: Optional[AdaptiveLimiter], metrics=None, tenancy=None
     ) -> Optional["BrownoutController"]:
         """Armed together with the limiter: one knob
         (`SPOTTER_TPU_ADMIT_TARGET_MS`) opts the whole overload-control
@@ -519,6 +554,7 @@ class BrownoutController:
             ),
             metrics=metrics,
             hold=hold,
+            tenancy=tenancy,
         )
 
     # -- state machine --
@@ -615,9 +651,19 @@ class BrownoutController:
         """Rung >= 3: how much to raise the effective detection threshold."""
         return self.threshold_boost if self._rung >= RUNG_THRESHOLD else 0.0
 
-    def shed_bulk(self) -> bool:
-        """Rung >= 4: bulk traffic is shed with 503 at admission."""
-        return self._rung >= RUNG_BULK_503
+    def shed_bulk(self, tenant: Optional[str] = None) -> bool:
+        """Rung >= 4: bulk traffic is shed with 503 at admission.
+
+        Per-tenant scoping (ISSUE 19): with the tenancy plane attached,
+        only tenants holding MORE than their weight-fair share of current
+        occupancy are shed — an in-quota tenant keeps full service even
+        at the deepest rung. With the plane off (or no tenant known) the
+        rung stays class-wide, exactly the pre-tenancy behavior."""
+        if self._rung < RUNG_BULK_503:
+            return False
+        if self.tenancy is not None and tenant is not None:
+            return self.tenancy.over_share(tenant)
+        return True
 
     def markers(self) -> list[str]:
         """Active degradation markers for the response-level `degraded`
@@ -654,10 +700,15 @@ def edge_limiter_from_env(metrics=None) -> Optional[AdaptiveLimiter]:
 
 
 def build_overload_control(
-    metrics=None, target_env: str = ADMIT_TARGET_ENV
+    metrics=None, target_env: str = ADMIT_TARGET_ENV, tenancy=None
 ) -> tuple[Optional[AdaptiveLimiter], Optional[BrownoutController]]:
     """The serving wiring: (limiter, brownout) from the env, both None when
-    the tier is off."""
+    the tier is off. `tenancy` (ISSUE 19) scopes revocation and the
+    bulk_503 rung per tenant when the isolation plane is armed."""
     limiter = AdaptiveLimiter.from_env(metrics=metrics, target_env=target_env)
-    brownout = BrownoutController.from_env(limiter, metrics=metrics)
+    if limiter is not None:
+        limiter.tenancy = tenancy
+    brownout = BrownoutController.from_env(
+        limiter, metrics=metrics, tenancy=tenancy
+    )
     return limiter, brownout

@@ -73,6 +73,7 @@ from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.obs.aggregate import FleetAggregator
 from spotter_tpu.serving import reconcile as reconcile_mod
+from spotter_tpu.serving import tenancy
 from spotter_tpu.serving import wire
 from spotter_tpu.serving.fleet import (
     REQUEST_CLASS_HEADER,
@@ -118,6 +119,17 @@ def edge_shed_response(limiter: AdaptiveLimiter, cls: str) -> web.Response:
     )
 
 
+def tenant_shed_response(exc: tenancy.TenantQuotaError) -> web.Response:
+    """429 for an over-quota tenant (ISSUE 19): the Retry-After hint is
+    tenant-scoped (that tenant's own bucket refill), already jittered by
+    the plane."""
+    return web.json_response(
+        {"error": str(exc), "status": exc.status, "tenant": exc.tenant},
+        status=exc.status,
+        headers={"Retry-After": f"{max(exc.retry_after_s, 0.0):.0f}"},
+    )
+
+
 class _BadGateway(RuntimeError):
     """A sub-response the fan-in cannot merge (non-200 in a split request,
     malformed frame): surfaced to the client as 502."""
@@ -146,6 +158,7 @@ def make_router_app(
     rollout=None,
     reconciler=None,
     quorum: QuorumSampler | None = None,
+    tenancy_plane: tenancy.TenantPlane | None = None,
 ) -> web.Application:
     """`limiter` (default: `SPOTTER_TPU_ADMIT_EDGE_TARGET_MS` via
     `edge_limiter_from_env`, None = off) adds the ISSUE 8 AIMD edge gate:
@@ -166,9 +179,17 @@ def make_router_app(
     None/state check per request. `reconciler` (ISSUE 16, default None)
     attaches a `reconcile.Reconciler`: /healthz grows a `control_plane`
     block (leadership + desired-vs-observed drift) and /metrics a
-    `reconcile` block (loop/adoption/fencing/rebuild counters)."""
+    `reconcile` block (loop/adoption/fencing/rebuild counters).
+    `tenancy_plane` (ISSUE 19, default `tenancy.from_env()` — None when
+    unconfigured) arms per-tenant edge quotas: over-quota tenants shed
+    429 with a tenant-scoped Retry-After BEFORE the body is read, the
+    resolved id is forwarded downstream in X-Spotter-Tenant, and
+    per-tenant admit/shed/occupancy counters ride /metrics under
+    `tenants` plus the admin-gated /debug/tenants full table."""
     if affinity is None:
         affinity = affinity_from_env()
+    if tenancy_plane is None:
+        tenancy_plane = tenancy.from_env()
     if edge_negative_ttl_s is None:
         edge_negative_ttl_s = _env_float(
             wire.EDGE_NEGATIVE_TTL_ENV, wire.DEFAULT_EDGE_NEGATIVE_TTL_S
@@ -436,16 +457,33 @@ def make_router_app(
         # replica stage. X-Request-ID is echoed on every outcome —
         # PoolSuspendedError fast-fails included.
         trace, request_id = obs_http.begin_http_trace(request)
+        tenant = None
+        tadm = None
 
         def done(resp: web.Response) -> web.Response:
             if resp.status in (429, 503) or resp.status >= 500:
                 slo_burn.bad()
             else:
                 slo_burn.good()
+            # per-tenant occupancy + SLO accounting (ISSUE 19): release
+            # exactly once, burning the tenant's budget on sheds/5xx
+            if tadm is not None:
+                tadm.release(
+                    good=resp.status not in (429, 503) and resp.status < 500
+                )
             return obs_http.finish_http_trace(
                 trace, request_id, resp, server_timing=True
             )
 
+        if tenancy_plane is not None:
+            # edge quota (ISSUE 19): identity comes from headers alone, so
+            # an over-quota tenant is shed 429 BEFORE the body is even
+            # read — strictly before any in-quota shed below
+            tenant = tenancy_plane.resolve(request.headers)
+            try:
+                tadm = tenancy_plane.try_admit(tenant)
+            except tenancy.TenantQuotaError as exc:
+                return done(tenant_shed_response(exc))
         with obs.span(obs.ROUTE, trace):
             raw = await request.read()
             wire_stats["bytes_in_total"] += len(raw)
@@ -465,6 +503,12 @@ def make_router_app(
         # the class rides downstream so the replica's limiter/brownout
         # apply the same bulk-before-slo ordering
         headers[REQUEST_CLASS_HEADER] = cls
+        if tenant is not None:
+            # the resolved tenant id rides downstream alongside
+            # X-Request-ID (ISSUE 19) so the replica's QueueItem, DRR
+            # ordering and per-tenant brownout see the same identity —
+            # fan-out sub-requests inherit these headers unchanged
+            headers[tenancy.TENANT_HEADER] = tenant
         # wire negotiation rides downstream too: when the client speaks
         # frames, the router->replica hop does as well — the base64 tax is
         # paid on neither hop
@@ -582,6 +626,9 @@ def make_router_app(
                 # output-integrity plane config (ISSUE 17): sampling share
                 # auditable per edge; 0 = quorum comparison off
                 "quorum_pct": quorum.pct,
+                # tenant isolation plane config (ISSUE 19): auditable per
+                # edge like the affinity/wire flags
+                "tenancy": tenancy_plane is not None,
                 # control plane (ISSUE 16): leadership + fencing epoch +
                 # desired-vs-observed drift, same block the fleet app serves
                 **reconcile_mod.healthz_block(reconciler),
@@ -646,9 +693,24 @@ def make_router_app(
         # quarantine counters + per-replica disagreement EWMAs; prom renders
         # integrity_quorum_disagreements_total, ...
         snap["integrity"] = {"quorum": quorum.snapshot()}
+        # tenant isolation plane (ISSUE 19): bounded top-K per-tenant rows;
+        # prom renders tenant_stat{tenant=...,stat=...}
+        if tenancy_plane is not None:
+            snap["tenants"] = tenancy_plane.metrics_view()
         return obs_http.metrics_response(request, snap)
 
+    async def debug_tenants(request: web.Request) -> web.Response:
+        """Full per-tenant table (ISSUE 19) — admin-token-gated like the
+        replica's /profile; the bounded top-K view lives in /metrics."""
+        rejected = obs_http.admin_rejection(request)
+        if rejected is not None:
+            return rejected
+        if tenancy_plane is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(tenancy_plane.snapshot())
+
     app.router.add_post("/detect", detect)
+    app.router.add_get("/debug/tenants", debug_tenants)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/livez", livez)
     app.router.add_get("/metrics", metrics)

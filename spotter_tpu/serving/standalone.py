@@ -54,10 +54,11 @@ from aiohttp import web
 from spotter_tpu.obs import http as obs_http
 from spotter_tpu.obs import logs as obs_logs
 from spotter_tpu.ops import preprocess
-from spotter_tpu.serving import integrity, lifecycle, wire
+from spotter_tpu.serving import integrity, lifecycle, tenancy, wire
 from spotter_tpu.serving.detector import QueriesUnsupportedError
 from spotter_tpu.serving.fleet import classify_request
 from spotter_tpu.serving.resilience import AdmissionError
+from spotter_tpu.serving.tenancy import TenantQuotaError
 from spotter_tpu.testing import faults, stub_engine
 
 logger = logging.getLogger(__name__)
@@ -150,6 +151,10 @@ def make_app(
     tracker = lifecycle.StartupTracker()
     app["startup"] = tracker
     app["detector"] = detector
+    # tenant isolation plane (ISSUE 19): None unless configured — every
+    # tenant branch below is then absent and serving is bit-identical
+    tenant_plane = tenancy.from_env()
+    app["tenancy"] = tenant_plane
     if faults.maybe_activate_from_env() is not None:
         logger.warning(
             "FAULT INJECTION ACTIVE (%s) — this server is a chaos target, "
@@ -207,6 +212,7 @@ def make_app(
         detector.engine.metrics.set_restarts(lifecycle.restarts_from_env())
         _stamp_identity(detector)
         _wire_fault_domain(detector)
+        detector.attach_tenancy(tenant_plane)
         tracker.mark_ready(detector.engine.metrics)
 
     def _make_integrity_recheck(plane):
@@ -231,6 +237,7 @@ def make_app(
             det.engine.metrics.set_restarts(lifecycle.restarts_from_env())
             _stamp_identity(det)
             _wire_fault_domain(det)
+            det.attach_tenancy(tenant_plane)
             # SDC injection seam (ISSUE 17, chaos only): corrupt the live
             # weights AFTER load, BEFORE verification — the flipped-bit-
             # after-restore shape the attestation gate must catch
@@ -298,8 +305,18 @@ def make_app(
         # included — echoes the request id, and completed traces land in
         # the flight recorder with per-stage Server-Timing on the response.
         trace, request_id = obs_http.begin_http_trace(request)
+        tenant = None
+        tadm = None
 
         def done(resp: web.Response) -> web.Response:
+            # per-tenant occupancy + SLO accounting (ISSUE 19): every
+            # outcome releases the inflight slot exactly once; sheds and
+            # server errors burn the tenant's budget, everything else
+            # credits it
+            if tadm is not None:
+                tadm.release(
+                    good=resp.status not in (429, 503) and resp.status < 500
+                )
             # replica identity header (ISSUE 14 satellite): every /detect
             # outcome — sheds and errors included — names the replica that
             # produced it, so a slow or corrupt response joins /debug/fleet
@@ -331,6 +348,20 @@ def make_app(
                     status=500,
                 )
             )
+        if tenant_plane is not None:
+            # edge quota (ISSUE 19): resolve the tenant and charge its
+            # token bucket / inflight cap BEFORE any parse/fetch/decode
+            # work — an over-quota tenant sheds 429 here, strictly before
+            # any in-quota request could be shed below
+            tenant = tenant_plane.resolve(request.headers)
+            try:
+                tadm = tenant_plane.try_admit(tenant)
+            except TenantQuotaError as exc:
+                det.engine.metrics.record_shed()
+                det.engine.metrics.record_admit_shed(
+                    classify_request(request.headers, None)[0]
+                )
+                return done(_shed_response(exc))
         shed = det.check_admission()
         if shed is not None:  # draining / breaker open: reject before parsing
             return done(_shed_response(shed))
@@ -344,14 +375,16 @@ def make_app(
         # ladder's bulk-only rung and the limiter's class-ordered shed work
         # with or without a fleet edge in front
         cls, payload = classify_request(request.headers, payload)
-        shed = det.check_admission(cls)
+        shed = det.check_admission(cls, tenant)
         if shed is not None:  # brownout bulk shed: reject before fetching
             return done(_shed_response(shed))
         # data-plane observations (ISSUE 11): per-URL cache outcomes for
         # X-Cache and deterministic-failure verdicts for X-Spotter-Negative
         info: dict = {}
         try:
-            response = await det.detect(payload, cls=cls, info=info)
+            response = await det.detect(
+                payload, cls=cls, info=info, tenant=tenant
+            )
         except pydantic.ValidationError as exc:
             return done(web.Response(status=400, text=f"Invalid request: {exc}"))
         except QueriesUnsupportedError as exc:
@@ -467,7 +500,21 @@ def make_app(
         plane = request.app.get("integrity")
         if plane is not None:
             snap["integrity"] = plane.snapshot()
+        # per-tenant accounting (ISSUE 19): bounded top-K view — prom
+        # renders it {tenant=..., stat=...}; absent when unconfigured
+        if tenant_plane is not None:
+            snap["tenants"] = tenant_plane.metrics_view()
         return obs_http.metrics_response(request, snap)
+
+    async def debug_tenants(request: web.Request) -> web.Response:
+        """Full per-tenant table (ISSUE 19) — admin-token-gated like
+        /profile; the bounded top-K view lives in /metrics."""
+        rejected = _admin_rejection(request)
+        if rejected is not None:
+            return rejected
+        if tenant_plane is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(tenant_plane.snapshot())
 
     async def profile(request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of in-flight device work.
@@ -535,6 +582,8 @@ def make_app(
     app.router.add_post("/drain", drain)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/profile", profile)
+    # per-tenant isolation table (ISSUE 19): admin-token-gated like /profile
+    app.router.add_get("/debug/tenants", debug_tenants)
     # flight-recorder view (ISSUE 7): admin-token-gated like /profile
     app.router.add_get("/debug/traces", obs_http.make_debug_traces_handler())
     # device-efficiency ledger view (ISSUE 10): top-K expensive dispatches

@@ -1401,3 +1401,297 @@ def run_integrity_matrix(
     for sc in scenarios if scenarios is not None else INTEGRITY_MATRIX:
         reports.append(asyncio.run(run_integrity_scenario(sc)))
     return reports
+
+
+# ---------------------------------------------------------------------------
+# tenant-isolation tier (ISSUE 19): noisy-neighbor drills over the real
+# router edge with the TenantPlane armed
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TenantScenario:
+    """One deterministic noisy-neighbor drill.
+
+    Same in-process topology — stub replicas behind the real pool +
+    router — but the router carries a `TenantPlane` built from
+    `config`/`default_rps`, driven by a FROZEN manual clock so token
+    buckets never refill mid-drill: a tenant's admit count is EXACTLY
+    min(sent, burst), an exact assertion instead of a pacing-dependent
+    threshold.
+
+    `load` maps tenant -> base request count; each tenant's load runs
+    concurrently with every other's. The abusive shapes come from the
+    faults.py ISSUE 19 seams: `tenant_flood=<t>:<x>` multiplies tenant
+    `t`'s base count by `x` (the fault IS the client's behavior — the
+    serving path is unmodified), and `tenant_retry_storm=<n>` makes the
+    flooding tenant fire `n` immediate Retry-After-ignoring re-sends per
+    429. `abuser` names the tenant under scrutiny for the occupancy row
+    (slow-loris holds connections open rather than flooding, so there is
+    no flood fault to name it)."""
+
+    name: str
+    config: dict = field(default_factory=dict)
+    default_rps: float = 0.0
+    load: dict = field(default_factory=dict)
+    concurrency: int = 4  # workers PER TENANT
+    abuser: str | None = None
+    replicas: int = 2
+    service_ms: float = 2.0
+    faults: dict = field(default_factory=dict)
+    invariants: dict = field(default_factory=dict)
+
+
+TENANT_MATRIX = [
+    TenantScenario(
+        name="tenant-flood",
+        # abuser quota 20 rps (burst 40); honest tenants 200 rps. The
+        # flood sends 6x the abuser's base 20 -> 120 requests against a
+        # frozen bucket holding exactly 40 tokens.
+        config={"abuser": {"rps": 20}, "honest-a": {"rps": 200},
+                "honest-b": {"rps": 200}},
+        load={"abuser": 20, "honest-a": 30, "honest-b": 30},
+        faults={"tenant_flood": "abuser:6"},
+        invariants={
+            "honest_failures": 0,   # not one in-quota request shed
+            "abuser_admits": 40,    # capped at burst, exactly
+            "abuser_sheds": 80,     # everything past the burst 429s
+        },
+    ),
+    TenantScenario(
+        name="tenant-retry-storm",
+        # every 429 is answered with 2 immediate re-sends that ignore
+        # Retry-After. Retries must gain NOTHING: admits stay pinned at
+        # the burst while the shed counter absorbs the storm.
+        config={"abuser": {"rps": 20}, "honest-a": {"rps": 200},
+                "honest-b": {"rps": 200}},
+        load={"abuser": 20, "honest-a": 30, "honest-b": 30},
+        faults={"tenant_flood": "abuser:4", "tenant_retry_storm": 2},
+        invariants={
+            "honest_failures": 0,
+            "abuser_admits": 40,
+            "abuser_sheds_gt": 40,  # 40 base sheds + storm amplification
+        },
+    ),
+    TenantScenario(
+        name="slow-loris-occupancy",
+        # the loris doesn't flood — it OCCUPIES: 6 workers hold slow
+        # requests open. Its max_inflight=2 bounds the seats it can take;
+        # overflow sheds with kind="inflight" and the honest tenant never
+        # waits behind it.
+        config={"loris": {"rps": 1000, "max_inflight": 2},
+                "honest-a": {"rps": 1000}},
+        load={"loris": 30, "honest-a": 30},
+        concurrency=6,
+        service_ms=20.0,
+        abuser="loris",
+        invariants={
+            "honest_failures": 0,
+            "inflight_sheds_gt": 0,
+        },
+    ),
+    TenantScenario(
+        name="many-small-tenants",
+        # 40 distinct tenant ids churning through: the tracked table grows
+        # to 40 but the /metrics view stays bounded at top_k rows plus the
+        # "other" overflow bucket — label cardinality is capped by design,
+        # not by scrape luck.
+        default_rps=50.0,
+        load={f"t{i:02d}": 3 for i in range(40)},
+        concurrency=1,
+        invariants={
+            "total_failures": 0,
+            "total_sheds": 0,
+            "tracked": 40,
+            "tenant_rows_lte": 9,  # top_k (8) + "other"
+        },
+    ),
+    TenantScenario(
+        name="bursty-in-quota",
+        # the false-positive row: a bursty-but-IN-QUOTA tenant dumps its
+        # entire burst allowance at once next to a steady neighbor and
+        # must see ZERO sheds — "bursty" alone is not abuse.
+        config={"bursty": {"rps": 30}, "steady": {"rps": 200}},
+        load={"bursty": 60, "steady": 30},  # 60 == bursty's burst, exactly
+        invariants={
+            "total_failures": 0,
+            "total_sheds": 0,
+        },
+    ),
+]
+
+
+async def run_tenant_scenario(sc: TenantScenario) -> dict:
+    """Execute one noisy-neighbor drill; returns the report dict."""
+    import random
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.engine.batcher import MicroBatcher
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving import tenancy
+    from spotter_tpu.serving.detector import AmenitiesDetector
+    from spotter_tpu.serving.replica_pool import ReplicaPool
+    from spotter_tpu.serving.router import make_router_app
+    from spotter_tpu.serving.standalone import make_app
+    from spotter_tpu.testing.stub_engine import StubEngine, StubHttpClient
+
+    engines, dets, servers, urls = [], [], [], []
+    for i in range(sc.replicas):
+        engine = StubEngine(service_ms=sc.service_ms)
+        engine.metrics.set_identity(replica_id=f"tenant-r{i}")
+        det = AmenitiesDetector(
+            engine, MicroBatcher(engine, max_delay_ms=1.0), StubHttpClient()
+        )
+        server = TestServer(make_app(detector=det))
+        await server.start_server()
+        engines.append(engine)
+        dets.append(det)
+        servers.append(server)
+        urls.append(f"http://{server.host}:{server.port}")
+
+    # frozen clock: buckets never refill, so admits == min(sent, burst)
+    # exactly; seeded rng pins the Retry-After jitter
+    plane = tenancy.TenantPlane(
+        config=sc.config,
+        default_rps=sc.default_rps,
+        clock=lambda: 0.0,
+        rng=random.Random(0),
+    )
+    pool = ReplicaPool(urls, health_interval_s=0.05, adaptive_hedge=True)
+    aggregator = FleetAggregator(lambda: [], interval_s=0.0)  # determinism
+    router_app = make_router_app(
+        pool, aggregator=aggregator, tenancy_plane=plane
+    )
+
+    per_tenant: dict[str, dict[int, int]] = {
+        t: {} for t in sc.load
+    }
+
+    with faults.inject(**sc.faults):
+        flood = faults.tenant_flood_spec()
+        storm_n = faults.tenant_retry_storm_n()
+        loads = dict(sc.load)
+        if flood is not None:
+            flood_tenant, factor = flood
+            loads[flood_tenant] = int(loads.get(flood_tenant, 0) * factor)
+
+        async with TestClient(TestServer(router_app)) as client:
+
+            async def one(tenant: str, i: int) -> int:
+                resp = await client.post(
+                    "/detect",
+                    json={"image_urls": [URL_CYCLE[i % len(URL_CYCLE)]]},
+                    headers={tenancy.TENANT_HEADER: tenant},
+                )
+                await resp.read()
+                stats = per_tenant[tenant]
+                stats[resp.status] = stats.get(resp.status, 0) + 1
+                return resp.status
+
+            async def tenant_load(tenant: str, n: int) -> None:
+                storming = (
+                    flood is not None and tenant == flood[0] and storm_n > 0
+                )
+                cursor = {"i": 0}
+
+                async def worker() -> None:
+                    while cursor["i"] < n:
+                        i = cursor["i"]
+                        cursor["i"] += 1
+                        status = await one(tenant, i)
+                        if status == 429 and storming:
+                            # the storm IGNORES Retry-After: immediate
+                            # re-sends, which must gain nothing
+                            for _ in range(storm_n):
+                                await one(tenant, i)
+
+                await asyncio.gather(
+                    *(worker() for _ in range(sc.concurrency))
+                )
+
+            await asyncio.gather(
+                *(tenant_load(t, n) for t, n in loads.items())
+            )
+
+    snap = plane.snapshot()
+    view = plane.metrics_view()
+
+    for server in servers:
+        await server.close()
+    for det in dets:
+        await det.aclose()
+
+    abuser = sc.abuser
+    if abuser is None and sc.faults.get("tenant_flood"):
+        abuser = str(sc.faults["tenant_flood"]).partition(":")[0]
+    honest = [t for t in sc.load if t != abuser]
+    arow = snap["tenants"].get(abuser, {}) if abuser else {}
+    report = {
+        "name": sc.name,
+        "per_tenant": per_tenant,
+        "abuser": abuser,
+        "honest_failures": sum(
+            c
+            for t in honest
+            for s, c in per_tenant[t].items()
+            if s != 200
+        ),
+        "total_failures": sum(
+            c
+            for stats in per_tenant.values()
+            for s, c in stats.items()
+            if s != 200
+        ),
+        "abuser_admits": int(arow.get("admits_total", 0)),
+        "abuser_sheds": int(
+            arow.get("sheds_rate_total", 0)
+            + arow.get("sheds_inflight_total", 0)
+        ),
+        "inflight_sheds": snap["sheds_total"]["inflight"],
+        "total_sheds": sum(snap["sheds_total"].values()),
+        "tracked": snap["tracked"],
+        "tenant_rows": len(view),
+        "plane": snap,
+    }
+    report["checks"] = evaluate_tenant(sc, report)
+    report["ok"] = all(report["checks"].values())
+    return report
+
+
+def evaluate_tenant(sc: TenantScenario, report: dict) -> dict:
+    """Invariant name -> bool, same contract as `evaluate`."""
+    checks: dict[str, bool] = {}
+    for key, want in sc.invariants.items():
+        if key == "honest_failures":
+            checks[key] = report["honest_failures"] == want
+        elif key == "total_failures":
+            checks[key] = report["total_failures"] == want
+        elif key == "abuser_admits":
+            checks[key] = report["abuser_admits"] == want
+        elif key == "abuser_sheds":
+            checks[key] = report["abuser_sheds"] == want
+        elif key == "abuser_sheds_gt":
+            checks[key] = report["abuser_sheds"] > want
+        elif key == "inflight_sheds_gt":
+            checks[key] = report["inflight_sheds"] > want
+        elif key == "total_sheds":
+            checks[key] = report["total_sheds"] == want
+        elif key == "tracked":
+            checks[key] = report["tracked"] == want
+        elif key == "tenant_rows_lte":
+            checks[key] = report["tenant_rows"] <= want
+        else:
+            raise ValueError(f"unknown invariant {key!r} in {sc.name}")
+    return checks
+
+
+def run_tenant_matrix(
+    scenarios: list[TenantScenario] | None = None,
+) -> list[dict]:
+    """Run every noisy-neighbor drill (fresh event loop each); returns
+    the reports — same contract as `run_matrix`."""
+    reports = []
+    for sc in scenarios if scenarios is not None else TENANT_MATRIX:
+        reports.append(asyncio.run(run_tenant_scenario(sc)))
+    return reports

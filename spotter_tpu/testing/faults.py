@@ -111,6 +111,20 @@ shapes the INTEGRITY_MATRIX and `bench.py --integrity-drill` compose:
   weights attest CLEAN but the probe's observed answer is perturbed, so
   only the `verifying` probe can catch it (exit 86; the supervisor
   quarantines the suspect compile-cache dir before the cold restart).
+
+The tenant-isolation tier (ISSUE 19) adds the two noisy-neighbor shapes
+the TENANT_MATRIX and `bench.py --tenant-storm` compose. Unlike the other
+tiers these don't fire inside the serving path — they parameterize the
+drill's LOAD GENERATOR (the abusive client is the fault, not the server):
+
+- `tenant_flood=<tenant>:<xQuota>`: the named tenant sends at xQuota
+  times its sustained rate (`tenant_flood_spec()` hands the parsed pair
+  to the generator) — the flood the token bucket must absorb while
+  honest tenants keep their goodput;
+- `tenant_retry_storm=<n>`: the abusive client ignores Retry-After and
+  immediately re-sends up to n times per shed (`tenant_retry_storm_n()`)
+  — the retry amplification the tenant-scoped jittered hint exists to
+  de-synchronize.
 """
 
 import asyncio
@@ -185,6 +199,12 @@ class FaultPlan:
     sdc: int = 0
     corrupt_weights: int = 0
     corrupt_compile_cache: int = 0
+    # ISSUE 19 tenant-isolation tier: "<tenant>:<xQuota>" (the named tenant
+    # floods at that multiple of its sustained rate) and the per-shed
+    # immediate-retry amplification of an abusive client — both consumed by
+    # drill load generators via tenant_flood_spec()/tenant_retry_storm_n()
+    tenant_flood: str = ""
+    tenant_retry_storm: int = 0
     # set() to un-wedge hanging engine calls early (tests)
     release: threading.Event = field(default_factory=threading.Event)
     _lock: threading.Lock = field(default_factory=threading.Lock)
@@ -258,11 +278,17 @@ def maybe_activate_from_env() -> FaultPlan | None:
             "sdc",
             "corrupt_weights",
             "corrupt_compile_cache",
+            "tenant_flood",
+            "tenant_retry_storm",
         ):
             raise ValueError(f"unknown {FAULTS_ENV} fault {key!r}")
         if key == "slow_stage":
             kwargs[key] = value.strip()
             _parse_slow_stage(kwargs[key])  # fail loudly at activation
+            continue
+        if key == "tenant_flood":
+            kwargs[key] = value.strip()
+            _parse_tenant_flood(kwargs[key])  # fail loudly at activation
             continue
         if key == "only_replica":
             kwargs[key] = value.strip()
@@ -571,6 +597,52 @@ def take_corrupt_compile_cache() -> bool:
     if plan is None:
         return False
     return plan._consume("corrupt_compile_cache")
+
+
+# ---- tenant-isolation tier (ISSUE 19) ----
+
+
+def _parse_tenant_flood(spec: str) -> tuple[str, float]:
+    """`"abuser:8"` -> ("abuser", 8.0): the named tenant floods at that
+    multiple of its sustained quota rate."""
+    tenant, sep, mult = spec.partition(":")
+    tenant = tenant.strip()
+    if not sep or not tenant:
+        raise ValueError(
+            f"bad tenant_flood entry {spec!r}: expected <tenant>:<xQuota>"
+        )
+    try:
+        factor = float(mult)
+    except ValueError:
+        raise ValueError(
+            f"bad tenant_flood entry {spec!r}: xQuota must be a number"
+        ) from None
+    if factor <= 0:
+        raise ValueError(
+            f"bad tenant_flood entry {spec!r}: xQuota must be > 0"
+        )
+    return tenant, factor
+
+
+def tenant_flood_spec() -> tuple[str, float] | None:
+    """Drill load-generator hook: (tenant, xQuota) while a tenant_flood
+    plan is active, else None. The fault is the CLIENT's behavior — the
+    generator sends the named tenant's traffic at xQuota times its
+    sustained rate; the serving path is unmodified (its token bucket is
+    the thing under test)."""
+    plan = _active
+    if plan is None or not plan.tenant_flood:
+        return None
+    return _parse_tenant_flood(plan.tenant_flood)
+
+
+def tenant_retry_storm_n() -> int:
+    """Drill load-generator hook: how many immediate (Retry-After-ignoring)
+    re-sends the abusive client fires per shed; 0 when not armed."""
+    plan = _active
+    if plan is None:
+        return 0
+    return max(plan.tenant_retry_storm, 0)
 
 
 def corrupt_frame_bytes(data: bytes, replica_id: str | None = None) -> bytes:

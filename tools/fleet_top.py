@@ -19,7 +19,10 @@ that is reported rather than rendered as an empty fleet. A
 controller-wired edge (ISSUE 16) also carries a `reconcile` block, which
 renders as a `control:` line — leadership + fencing epoch and the
 desired-vs-observed drift per pool — so an operator sees "spot 2/3
-ready" next to the replica rows it explains.
+ready" next to the replica rows it explains. A tenancy-armed edge
+(ISSUE 19) carries a bounded `tenants` block, which renders as per-tenant
+rows (inflight, admits, sheds by kind, SLO burn) under the replica table
+— who is being shed, and who is eating the capacity, in one screen.
 """
 
 import argparse
@@ -80,6 +83,58 @@ def _control_plane(snapshot: dict) -> str | None:
     )
 
 
+TENANT_COLUMNS = (
+    # (header, width, stat key in the /metrics `tenants` rows)
+    ("TENANT", 16, None),
+    ("INFLT", 6, "inflight"),
+    ("ADMITS", 8, "admits_total"),
+    ("SHED/R", 7, "sheds_rate_total"),
+    ("SHED/I", 7, "sheds_inflight_total"),
+    ("BURN", 7, "slo_burn"),
+    ("WEIGHT", 6, "weight"),
+    ("RPS", 7, "rps"),
+)
+
+
+def _tenant_lines(snapshot: dict) -> list[str]:
+    """Per-tenant rows (ISSUE 19) from the bounded `tenants` block a
+    tenancy-armed edge embeds in /metrics: top-K tenants by admits plus
+    the `other` overflow row. Empty (no lines, no header) when the edge
+    has tenancy unconfigured — the same absent-plane discipline as
+    `_control_plane`."""
+    tenants = snapshot.get("tenants")
+    if not isinstance(tenants, dict) or not tenants:
+        return []
+    lines = ["", "  ".join(h.ljust(w) for h, w, _ in TENANT_COLUMNS)]
+    # "other" sorts last; real tenants by admits (the metrics_view rank)
+    ranked = sorted(
+        tenants.items(),
+        key=lambda kv: (
+            kv[0] == "other",
+            -float((kv[1] or {}).get("admits_total", 0) or 0),
+            kv[0],
+        ),
+    )
+    for name, row in ranked:
+        row = row if isinstance(row, dict) else {}
+        cells = []
+        for _h, w, key in TENANT_COLUMNS:
+            if key is None:
+                cell = str(name)
+            else:
+                try:
+                    v = float(row.get(key, 0) or 0)
+                    cell = (
+                        f"{v:.2f}" if key in ("slo_burn", "weight")
+                        else f"{v:.0f}"
+                    )
+                except (TypeError, ValueError):
+                    cell = "-"
+            cells.append(cell[:w].ljust(w))
+        lines.append("  ".join(cells))
+    return lines
+
+
 def _state(row: dict) -> str:
     if not row.get("up"):
         return "down"
@@ -131,6 +186,7 @@ def render(snapshot: dict) -> str:
         lines.append("  ".join(cells))
     if not fleet.get("per_replica"):
         lines.append("(no replicas scraped yet)")
+    lines.extend(_tenant_lines(snapshot))
     return "\n".join(lines)
 
 
