@@ -2455,7 +2455,12 @@ def tenant_storm_bench(args) -> int:
         config = {"abuser": {"rps": abuser_rps, "burst": abuser_rps}}
         for name in honest_names:
             config[name] = {"rps": 5000.0}
-        return tenancy.TenantPlane(config=config, rng=random.Random(0))
+        # trust_header: the storm clients model traffic whose identity an
+        # attested edge already resolved (the plane distrusts bare headers
+        # by default); the drill measures isolation between KNOWN tenants
+        return tenancy.TenantPlane(
+            config=config, rng=random.Random(0), trust_header=True
+        )
 
     async def storm_phases() -> dict:
         engines, dets, servers, urls = await build_fleet("tenant-bench-r")

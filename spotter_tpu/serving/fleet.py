@@ -805,66 +805,77 @@ def make_fleet_app(
                 tadm = tenancy_plane.try_admit(tenant)
             except tenancy_mod.TenantQuotaError as exc:
                 return done(tenant_shed_response(exc))
-        with obs.span(obs.ROUTE, trace):
-            try:
-                payload = await request.json()
-            except json.JSONDecodeError:
-                return done(web.Response(status=400, text="Invalid JSON body"))
-            cls, payload = classify_request(
-                request.headers, payload, default=controller.default_class
-            )
-        adm = None
-        if limiter is not None:
-            adm = limiter.try_admit(cls)
-            if adm is None:  # over the adaptive edge limit: bulk sheds first
-                from spotter_tpu.serving.router import edge_shed_response
-
-                return done(edge_shed_response(limiter, cls))
-        # forward the class so replica-level overload control (limiter
-        # class ordering, brownout bulk rung) sees the same verdict
-        headers = obs_http.forward_headers(trace, request_id)
-        headers[REQUEST_CLASS_HEADER] = cls
-        if tenant is not None:
-            # resolved tenant id rides downstream alongside X-Request-ID
-            # (ISSUE 19) so the replica scopes by the same identity
-            from spotter_tpu.serving.tenancy import TENANT_HEADER
-
-            headers[TENANT_HEADER] = tenant
-        t_fwd = time.monotonic()
         try:
-            resp = await controller.request(
-                "/detect", payload, cls, headers=headers
-            )
-        except PoolExhaustedError as exc:
-            return done(
-                web.json_response(
-                    {"error": str(exc), "status": 503, "request_class": cls},
-                    status=503,
-                    headers=retry_after_header(exc),
+            with obs.span(obs.ROUTE, trace):
+                try:
+                    payload = await request.json()
+                except json.JSONDecodeError:
+                    return done(web.Response(status=400, text="Invalid JSON body"))
+                cls, payload = classify_request(
+                    request.headers, payload, default=controller.default_class
                 )
-            )
-        finally:
-            elapsed_s = time.monotonic() - t_fwd
+            adm = None
             if limiter is not None:
-                limiter.observe(elapsed_s * 1000.0)
-            if adm is not None:
-                adm.release()
-        with obs.span(obs.ROUTE, trace):
-            # replica stages + the transport remainder as a network span:
-            # the edge trace tiles against the latency the client saw
-            obs_http.merge_downstream(trace, resp.headers, elapsed_s)
-            out = web.Response(
-                status=resp.status_code,
-                body=resp.content,
-                content_type="application/json",
-            )
-            rid = resp.headers.get(wire.REPLICA_HEADER)
-            if rid:  # replica identity rides through the fleet edge too
-                out.headers[wire.REPLICA_HEADER] = rid
-            ver = resp.headers.get(wire.VERSION_HEADER)
-            if ver:  # deploy version too (ISSUE 15)
-                out.headers[wire.VERSION_HEADER] = ver
-        return done(out)
+                adm = limiter.try_admit(cls)
+                if adm is None:  # over the adaptive edge limit: bulk sheds first
+                    from spotter_tpu.serving.router import edge_shed_response
+
+                    return done(edge_shed_response(limiter, cls))
+            # forward the class so replica-level overload control (limiter
+            # class ordering, brownout bulk rung) sees the same verdict
+            headers = obs_http.forward_headers(trace, request_id)
+            headers[REQUEST_CLASS_HEADER] = cls
+            if tenant is not None:
+                # resolved tenant id rides downstream alongside X-Request-ID
+                # (ISSUE 19) so the replica scopes by the same identity;
+                # stamp() adds the edge-attestation token when configured
+                # (REVIEW: a bare forwarded header is untrusted there too)
+                tenancy_plane.stamp(headers, tenant)
+            t_fwd = time.monotonic()
+            try:
+                resp = await controller.request(
+                    "/detect", payload, cls, headers=headers
+                )
+            except PoolExhaustedError as exc:
+                return done(
+                    web.json_response(
+                        {"error": str(exc), "status": 503, "request_class": cls},
+                        status=503,
+                        headers=retry_after_header(exc),
+                    )
+                )
+            finally:
+                elapsed_s = time.monotonic() - t_fwd
+                if limiter is not None:
+                    limiter.observe(elapsed_s * 1000.0)
+                if adm is not None:
+                    adm.release()
+            with obs.span(obs.ROUTE, trace):
+                # replica stages + the transport remainder as a network span:
+                # the edge trace tiles against the latency the client saw
+                obs_http.merge_downstream(trace, resp.headers, elapsed_s)
+                out = web.Response(
+                    status=resp.status_code,
+                    body=resp.content,
+                    content_type="application/json",
+                )
+                rid = resp.headers.get(wire.REPLICA_HEADER)
+                if rid:  # replica identity rides through the fleet edge too
+                    out.headers[wire.REPLICA_HEADER] = rid
+                ver = resp.headers.get(wire.VERSION_HEADER)
+                if ver:  # deploy version too (ISSUE 15)
+                    out.headers[wire.VERSION_HEADER] = ver
+            return done(out)
+        finally:
+            # leak guard (REVIEW): a client disconnect (CancelledError
+            # in any await) or an uncaught error below must still free
+            # the tenant's inflight slot, or the tenant is permanently
+            # 429-locked at its inflight cap and its occupancy skews
+            # the limiter/brownout forever. Idempotent: when done()
+            # ran, it already released with the real outcome; this
+            # no-outcome release never touches the SLO burn.
+            if tadm is not None:
+                tadm.release(good=None)
 
     async def healthz(request: web.Request) -> web.Response:
         available = {

@@ -62,6 +62,7 @@ import argparse
 import asyncio
 import json
 import logging
+import math
 import os
 import time
 
@@ -122,11 +123,19 @@ def edge_shed_response(limiter: AdaptiveLimiter, cls: str) -> web.Response:
 def tenant_shed_response(exc: tenancy.TenantQuotaError) -> web.Response:
     """429 for an over-quota tenant (ISSUE 19): the Retry-After hint is
     tenant-scoped (that tenant's own bucket refill), already jittered by
-    the plane."""
+    the plane. The header is integer seconds and must never render 0 —
+    a sub-second hint would invite the exact immediate retries the shed
+    exists to push back, so the precise float rides in the body and the
+    header ceils to at least 1 (the fleet's retry_after_header floor)."""
     return web.json_response(
-        {"error": str(exc), "status": exc.status, "tenant": exc.tenant},
+        {
+            "error": str(exc),
+            "status": exc.status,
+            "tenant": exc.tenant,
+            "retry_after_s": round(max(exc.retry_after_s, 0.0), 3),
+        },
         status=exc.status,
-        headers={"Retry-After": f"{max(exc.retry_after_s, 0.0):.0f}"},
+        headers={"Retry-After": f"{max(1, math.ceil(exc.retry_after_s))}"},
     )
 
 
@@ -484,123 +493,137 @@ def make_router_app(
                 tadm = tenancy_plane.try_admit(tenant)
             except tenancy.TenantQuotaError as exc:
                 return done(tenant_shed_response(exc))
-        with obs.span(obs.ROUTE, trace):
-            raw = await request.read()
-            wire_stats["bytes_in_total"] += len(raw)
-            try:
-                payload = json.loads(raw)
-                if not isinstance(payload, dict):
-                    raise json.JSONDecodeError("not an object", "{}", 0)
-            except (json.JSONDecodeError, UnicodeDecodeError):
-                return done(web.Response(status=400, text="Invalid JSON body"))
-            cls, payload = classify_request(request.headers, payload)
-        adm = None
-        if limiter is not None:
-            adm = limiter.try_admit(cls)
-            if adm is None:  # over the adaptive limit: bulk sheds first
-                return done(edge_shed_response(limiter, cls))
-        headers = obs_http.forward_headers(trace, request_id)
-        # the class rides downstream so the replica's limiter/brownout
-        # apply the same bulk-before-slo ordering
-        headers[REQUEST_CLASS_HEADER] = cls
-        if tenant is not None:
-            # the resolved tenant id rides downstream alongside
-            # X-Request-ID (ISSUE 19) so the replica's QueueItem, DRR
-            # ordering and per-tenant brownout see the same identity —
-            # fan-out sub-requests inherit these headers unchanged
-            headers[tenancy.TENANT_HEADER] = tenant
-        # wire negotiation rides downstream too: when the client speaks
-        # frames, the router->replica hop does as well — the base64 tax is
-        # paid on neither hop
-        client_frame = wire.wants_frame(request.headers.get("Accept"))
-        if client_frame:
-            headers["Accept"] = wire.FRAME_CONTENT_TYPE
-        urls = payload.get("image_urls")
-        splittable = (
-            affinity
-            and isinstance(urls, list)
-            and bool(urls)
-            and all(isinstance(u, str) for u in urls)
-        )
-        t_fwd = time.monotonic()
-        downstream: list = []
-        primary_url: str | None = None
         try:
-            if splittable:
-                out, downstream, primary_url = await _forward_affinity(
-                    urls, payload, headers, client_frame
-                )
-            else:
-                resp = await pool.request(
-                    "/detect", payload, headers=headers,
-                    validator=pool_validator,
-                )
-                downstream = [resp.headers]
-                _absorb_sub("", resp)
-                out = _passthrough(resp, client_frame)
-                primary_url = _base_url(resp)
-        except PoolExhaustedError as exc:
-            return done(
-                web.json_response(
-                    {"error": str(exc), "status": 503},
-                    status=503,
-                    headers=retry_after_header(exc),
-                )
-            )
-        except _BadGateway as exc:
-            return done(
-                web.json_response(
-                    {"error": str(exc), "status": 502}, status=502
-                )
-            )
-        finally:
-            elapsed_s = time.monotonic() - t_fwd
+            with obs.span(obs.ROUTE, trace):
+                raw = await request.read()
+                wire_stats["bytes_in_total"] += len(raw)
+                try:
+                    payload = json.loads(raw)
+                    if not isinstance(payload, dict):
+                        raise json.JSONDecodeError("not an object", "{}", 0)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    return done(web.Response(status=400, text="Invalid JSON body"))
+                cls, payload = classify_request(request.headers, payload)
+            adm = None
             if limiter is not None:
-                # edge control signal: downstream round-trip latency
-                limiter.observe(elapsed_s * 1000.0)
-            if adm is not None:
-                adm.release()
-        with obs.span(obs.ROUTE, trace):
-            # replica stages + the transport remainder as a network span:
-            # the edge trace tiles against the latency the client saw.
-            # Fanned-out sub-requests ran concurrently, so the remainder is
-            # measured against the SLOWEST hop's attributed time.
-            merged_max = 0.0
-            for hdrs in downstream:
-                merged_max = max(
-                    merged_max,
-                    obs_http.merge_server_timing(
-                        trace, hdrs.get(obs_http.SERVER_TIMING_HEADER)
-                    ),
-                )
-            if downstream and trace is not None:
-                net_ms = elapsed_s * 1e3 - merged_max
-                if net_ms > 0.0:
-                    trace.add_span_ms(obs_http.NETWORK, 0.0, net_ms)
-        # shadow lane (ISSUE 15): mirror this already-served request to the
-        # rollout canary on the sampled lane — fire-and-forget, response
-        # discarded, so nothing here can touch what the client got. Frame
-        # bodies are skipped (the lane compares JSON detections).
-        if (
-            rollout is not None
-            and out.status == 200
-            and not client_frame
-        ):
-            rollout.maybe_shadow(payload, out.body)
-        # quorum sampling (ISSUE 17): re-ask this already-served request of
-        # a SECOND ranked replica and compare — fire-and-forget like the
-        # shadow lane, so disagreement detection never adds client latency.
-        # Only single-replica-served JSON responses are attributable.
-        if (
-            out.status == 200
-            and not client_frame
-            and primary_url
-            and quorum.take()
-        ):
-            asyncio.ensure_future(
-                quorum.run_one(pool.client, payload, out.body, primary_url)
+                adm = limiter.try_admit(cls)
+                if adm is None:  # over the adaptive limit: bulk sheds first
+                    return done(edge_shed_response(limiter, cls))
+            headers = obs_http.forward_headers(trace, request_id)
+            # the class rides downstream so the replica's limiter/brownout
+            # apply the same bulk-before-slo ordering
+            headers[REQUEST_CLASS_HEADER] = cls
+            if tenant is not None:
+                # the resolved tenant id rides downstream alongside
+                # X-Request-ID (ISSUE 19) so the replica's QueueItem, DRR
+                # ordering and per-tenant brownout see the same identity —
+                # fan-out sub-requests inherit these headers unchanged.
+                # stamp() adds the edge-attestation token when configured,
+                # so the replica's plane honors the id (REVIEW: a bare
+                # forwarded header is otherwise untrusted there too)
+                tenancy_plane.stamp(headers, tenant)
+            # wire negotiation rides downstream too: when the client speaks
+            # frames, the router->replica hop does as well — the base64 tax is
+            # paid on neither hop
+            client_frame = wire.wants_frame(request.headers.get("Accept"))
+            if client_frame:
+                headers["Accept"] = wire.FRAME_CONTENT_TYPE
+            urls = payload.get("image_urls")
+            splittable = (
+                affinity
+                and isinstance(urls, list)
+                and bool(urls)
+                and all(isinstance(u, str) for u in urls)
             )
-        return done(out)
+            t_fwd = time.monotonic()
+            downstream: list = []
+            primary_url: str | None = None
+            try:
+                if splittable:
+                    out, downstream, primary_url = await _forward_affinity(
+                        urls, payload, headers, client_frame
+                    )
+                else:
+                    resp = await pool.request(
+                        "/detect", payload, headers=headers,
+                        validator=pool_validator,
+                    )
+                    downstream = [resp.headers]
+                    _absorb_sub("", resp)
+                    out = _passthrough(resp, client_frame)
+                    primary_url = _base_url(resp)
+            except PoolExhaustedError as exc:
+                return done(
+                    web.json_response(
+                        {"error": str(exc), "status": 503},
+                        status=503,
+                        headers=retry_after_header(exc),
+                    )
+                )
+            except _BadGateway as exc:
+                return done(
+                    web.json_response(
+                        {"error": str(exc), "status": 502}, status=502
+                    )
+                )
+            finally:
+                elapsed_s = time.monotonic() - t_fwd
+                if limiter is not None:
+                    # edge control signal: downstream round-trip latency
+                    limiter.observe(elapsed_s * 1000.0)
+                if adm is not None:
+                    adm.release()
+            with obs.span(obs.ROUTE, trace):
+                # replica stages + the transport remainder as a network span:
+                # the edge trace tiles against the latency the client saw.
+                # Fanned-out sub-requests ran concurrently, so the remainder is
+                # measured against the SLOWEST hop's attributed time.
+                merged_max = 0.0
+                for hdrs in downstream:
+                    merged_max = max(
+                        merged_max,
+                        obs_http.merge_server_timing(
+                            trace, hdrs.get(obs_http.SERVER_TIMING_HEADER)
+                        ),
+                    )
+                if downstream and trace is not None:
+                    net_ms = elapsed_s * 1e3 - merged_max
+                    if net_ms > 0.0:
+                        trace.add_span_ms(obs_http.NETWORK, 0.0, net_ms)
+            # shadow lane (ISSUE 15): mirror this already-served request to the
+            # rollout canary on the sampled lane — fire-and-forget, response
+            # discarded, so nothing here can touch what the client got. Frame
+            # bodies are skipped (the lane compares JSON detections).
+            if (
+                rollout is not None
+                and out.status == 200
+                and not client_frame
+            ):
+                rollout.maybe_shadow(payload, out.body)
+            # quorum sampling (ISSUE 17): re-ask this already-served request of
+            # a SECOND ranked replica and compare — fire-and-forget like the
+            # shadow lane, so disagreement detection never adds client latency.
+            # Only single-replica-served JSON responses are attributable.
+            if (
+                out.status == 200
+                and not client_frame
+                and primary_url
+                and quorum.take()
+            ):
+                asyncio.ensure_future(
+                    quorum.run_one(pool.client, payload, out.body, primary_url)
+                )
+            return done(out)
+        finally:
+            # leak guard (REVIEW): a client disconnect (CancelledError
+            # in any await) or an uncaught error below must still free
+            # the tenant's inflight slot, or the tenant is permanently
+            # 429-locked at its inflight cap and its occupancy skews
+            # the limiter/brownout forever. Idempotent: when done()
+            # ran, it already released with the real outcome; this
+            # no-outcome release never touches the SLO burn.
+            if tadm is not None:
+                tadm.release(good=None)
 
     async def healthz(request: web.Request) -> web.Response:
         now = time.monotonic()

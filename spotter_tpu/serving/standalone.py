@@ -45,6 +45,7 @@ import argparse
 import asyncio
 import json
 import logging
+import math
 import os
 import tempfile
 
@@ -79,10 +80,18 @@ def _rmdir_quiet(path: str) -> None:
 
 
 def _shed_response(exc: AdmissionError) -> web.Response:
+    # Retry-After never renders 0 (REVIEW): sub-second hints (the tenant
+    # rate-shed jitter floors at 0.05 s) ceil to 1 — a "0" header invites
+    # the immediate retry the shed exists to push back. The precise float
+    # rides in the body for clients that want fast pacing.
     return web.json_response(
-        {"error": str(exc), "status": exc.status},
+        {
+            "error": str(exc),
+            "status": exc.status,
+            "retry_after_s": round(max(exc.retry_after_s, 0.0), 3),
+        },
         status=exc.status,
-        headers={"Retry-After": f"{max(exc.retry_after_s, 0.0):.0f}"},
+        headers={"Retry-After": f"{max(1, math.ceil(exc.retry_after_s))}"},
     )
 
 
@@ -362,77 +371,88 @@ def make_app(
                     classify_request(request.headers, None)[0]
                 )
                 return done(_shed_response(exc))
-        shed = det.check_admission()
-        if shed is not None:  # draining / breaker open: reject before parsing
-            return done(_shed_response(shed))
         try:
-            payload = await request.json()
-        except json.JSONDecodeError:
-            return done(web.Response(status=400, text="Invalid JSON body"))
-        # request class (ISSUE 8): X-Request-Class header > request_class
-        # payload key (stripped) > deadline tag > env default — the PR 6
-        # fleet precedence, honored at the replica too so the brownout
-        # ladder's bulk-only rung and the limiter's class-ordered shed work
-        # with or without a fleet edge in front
-        cls, payload = classify_request(request.headers, payload)
-        shed = det.check_admission(cls, tenant)
-        if shed is not None:  # brownout bulk shed: reject before fetching
-            return done(_shed_response(shed))
-        # data-plane observations (ISSUE 11): per-URL cache outcomes for
-        # X-Cache and deterministic-failure verdicts for X-Spotter-Negative
-        info: dict = {}
-        try:
-            response = await det.detect(
-                payload, cls=cls, info=info, tenant=tenant
+            shed = det.check_admission()
+            if shed is not None:  # draining / breaker open: reject before parsing
+                return done(_shed_response(shed))
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                return done(web.Response(status=400, text="Invalid JSON body"))
+            # request class (ISSUE 8): X-Request-Class header > request_class
+            # payload key (stripped) > deadline tag > env default — the PR 6
+            # fleet precedence, honored at the replica too so the brownout
+            # ladder's bulk-only rung and the limiter's class-ordered shed work
+            # with or without a fleet edge in front
+            cls, payload = classify_request(request.headers, payload)
+            shed = det.check_admission(cls, tenant)
+            if shed is not None:  # brownout bulk shed: reject before fetching
+                return done(_shed_response(shed))
+            # data-plane observations (ISSUE 11): per-URL cache outcomes for
+            # X-Cache and deterministic-failure verdicts for X-Spotter-Negative
+            info: dict = {}
+            try:
+                response = await det.detect(
+                    payload, cls=cls, info=info, tenant=tenant
+                )
+            except pydantic.ValidationError as exc:
+                return done(web.Response(status=400, text=f"Invalid request: {exc}"))
+            except QueriesUnsupportedError as exc:
+                # open-vocab queries on a closed-set model (ISSUE 13): the
+                # request can never succeed on this deployment — a client
+                # error, not a server one
+                return done(web.Response(status=400, text=str(exc)))
+            except AdmissionError as exc:  # every image shed -> 429/503
+                return done(_shed_response(exc))
+            except Exception:
+                logger.exception("detect failed")
+                return done(web.Response(status=500, text="Internal server error"))
+            body = response.model_dump(exclude_none=True)
+            # binary wire format (ISSUE 11): `Accept: application/x-spotter-frame`
+            # negotiates the length-prefixed frame (raw JPEG segments, deflated
+            # header — no base64 tax). NOT negotiated -> the exact pre-existing
+            # json_response call, byte-identical on the wire (exclude_none: the
+            # `degraded` marker is absent unless a brownout concession shaped
+            # this response — schemas.py contract).
+            frame = wire.wants_frame(request.headers.get("Accept"))
+            if frame:
+                # corrupt_frame injection (ISSUE 14): while armed, one byte of
+                # the encoded frame is flipped AFTER the checksums were
+                # computed — the deterministic way to prove the edge CRC
+                # validator catches, counts, and replays corruption
+                resp = web.Response(
+                    body=faults.corrupt_frame_bytes(
+                        wire.encode_frame(body), det.engine.metrics.replica_id
+                    ),
+                    content_type=wire.FRAME_CONTENT_TYPE,
+                )
+            else:
+                resp = web.json_response(body)
+            x_cache = wire.summarize_cache_outcomes(
+                (info.get("cache") or {}).values()
             )
-        except pydantic.ValidationError as exc:
-            return done(web.Response(status=400, text=f"Invalid request: {exc}"))
-        except QueriesUnsupportedError as exc:
-            # open-vocab queries on a closed-set model (ISSUE 13): the
-            # request can never succeed on this deployment — a client
-            # error, not a server one
-            return done(web.Response(status=400, text=str(exc)))
-        except AdmissionError as exc:  # every image shed -> 429/503
-            return done(_shed_response(exc))
-        except Exception:
-            logger.exception("detect failed")
-            return done(web.Response(status=500, text="Internal server error"))
-        body = response.model_dump(exclude_none=True)
-        # binary wire format (ISSUE 11): `Accept: application/x-spotter-frame`
-        # negotiates the length-prefixed frame (raw JPEG segments, deflated
-        # header — no base64 tax). NOT negotiated -> the exact pre-existing
-        # json_response call, byte-identical on the wire (exclude_none: the
-        # `degraded` marker is absent unless a brownout concession shaped
-        # this response — schemas.py contract).
-        frame = wire.wants_frame(request.headers.get("Accept"))
-        if frame:
-            # corrupt_frame injection (ISSUE 14): while armed, one byte of
-            # the encoded frame is flipped AFTER the checksums were
-            # computed — the deterministic way to prove the edge CRC
-            # validator catches, counts, and replays corruption
-            resp = web.Response(
-                body=faults.corrupt_frame_bytes(
-                    wire.encode_frame(body), det.engine.metrics.replica_id
-                ),
-                content_type=wire.FRAME_CONTENT_TYPE,
+            if x_cache is not None:
+                resp.headers[wire.X_CACHE_HEADER] = x_cache
+            verdicts = wire.encode_negative_header(info.get("negative") or {})
+            if verdicts is not None:
+                resp.headers[wire.NEGATIVE_HEADER] = verdicts
+            out_bytes = resp.body
+            det.engine.metrics.record_wire(
+                request.content_length or 0,
+                len(out_bytes) if isinstance(out_bytes, (bytes, bytearray)) else 0,
+                frame,
             )
-        else:
-            resp = web.json_response(body)
-        x_cache = wire.summarize_cache_outcomes(
-            (info.get("cache") or {}).values()
-        )
-        if x_cache is not None:
-            resp.headers[wire.X_CACHE_HEADER] = x_cache
-        verdicts = wire.encode_negative_header(info.get("negative") or {})
-        if verdicts is not None:
-            resp.headers[wire.NEGATIVE_HEADER] = verdicts
-        out_bytes = resp.body
-        det.engine.metrics.record_wire(
-            request.content_length or 0,
-            len(out_bytes) if isinstance(out_bytes, (bytes, bytearray)) else 0,
-            frame,
-        )
-        return done(resp)
+            return done(resp)
+        finally:
+            # leak guard (REVIEW): a client disconnect (CancelledError
+            # in any await) or an uncaught error below must still free
+            # the tenant's inflight slot, or the tenant is permanently
+            # 429-locked at its inflight cap and its occupancy skews
+            # the limiter/brownout forever. Idempotent: when done()
+            # ran, it already released with the real outcome; this
+            # no-outcome release never touches the SLO burn.
+            if tadm is not None:
+                tadm.release(good=None)
 
     async def startupz(request: web.Request) -> web.Response:
         """Startup probe: 200 only once the replica reached ready. A long

@@ -10,13 +10,24 @@ in-quota tenant's p99 must be invariant to what other tenants do.
 
 Four pieces, stdlib-only (edges and the supervisor import through here):
 
-- **Identity** (`TenantPlane.resolve`): tenant id from the
-  `X-Spotter-Tenant` header, else the API-key map
+- **Identity** (`TenantPlane.resolve`): the `X-Spotter-Tenant` header
+  names a tenant but is NEVER trusted bare — any client can type any
+  header, and a spoofed id would let an abuser impersonate a high-quota
+  tenant, poison a victim's SLO/occupancy accounting, or dodge its own
+  bucket by rotating fresh ids. The header is honored only when (a) the
+  request carries the edge-attestation token (`X-Spotter-Edge-Token`
+  matching `SPOTTER_TPU_TENANT_EDGE_SECRET` — edges stamp it on
+  forwarded requests via `stamp()`, so edge->replica propagation is
+  attested), (b) it matches the tenant the API-key map resolves
   (`SPOTTER_TPU_TENANT_KEYS`, a JSON file of api-key -> tenant, checked
-  against `X-API-Key`), else `"anon"`. Edges re-stamp the resolved id
-  into the forwarded `X-Spotter-Tenant` header alongside `X-Request-ID`
-  so the replica, its QueueItem, and its traces all agree on who a
-  request belongs to.
+  against `X-API-Key`), or (c) `SPOTTER_TPU_TENANT_TRUST_HEADER=1`
+  explicitly opts a deployment in (header attested upstream: mTLS
+  ingress, service mesh). Otherwise identity falls back to the API-key
+  map alone, else `"anon"` — every unauthenticated client shares ONE
+  bucket, so inventing ids gains nothing. Edges re-stamp the RESOLVED
+  id (plus the attestation token) into the forwarded header alongside
+  `X-Request-ID` so the replica, its QueueItem, and its traces all
+  agree on who a request belongs to.
 - **Token-bucket quotas** (`TokenBucket`, `TenantPlane.try_admit`):
   per-tenant rate + burst from `SPOTTER_TPU_TENANT_CONFIG` (a path to —
   or inline — JSON; see below) with `SPOTTER_TPU_TENANT_RPS_DEFAULT` as
@@ -27,9 +38,11 @@ Four pieces, stdlib-only (edges and the supervisor import through here):
 - **Fair scheduling** (`TenantPlane.drr_order`): deficit-weighted
   round-robin across active tenants for the scheduler's within-class
   ordering — a flooding tenant queues behind its own backlog, not the
-  fleet's. With one distinct tenant (or the plane unconfigured) the
-  input order is returned UNCHANGED: FIFO semantics stay bit-identical,
-  the same opt-out discipline as the RAGGED/ADMIT knobs.
+  fleet's. Fairness is PER CALL: each plan() round reorders the whole
+  pending backlog it was handed, which is the window that matters.
+  With one distinct tenant (or the plane unconfigured) the input order
+  is returned UNCHANGED: FIFO semantics stay bit-identical, the same
+  opt-out discipline as the RAGGED/ADMIT knobs.
 - **Per-tenant accounting** (`record_outcome`, `metrics_view`,
   `snapshot`): admit/shed/occupancy counters + an `SloBurn` per tenant.
   `/metrics` exposure is BOUNDED: top-K tenants by admits
@@ -55,6 +68,7 @@ merely idle — in an unconfigured deployment, and serving is bit-identical
 to a pre-tenancy build (test-asserted).
 """
 
+import hmac
 import json
 import logging
 import os
@@ -74,12 +88,17 @@ logger = logging.getLogger(__name__)
 
 TENANT_HEADER = "X-Spotter-Tenant"
 API_KEY_HEADER = "X-API-Key"
+# edge attestation (REVIEW): carries the shared secret that makes a
+# forwarded X-Spotter-Tenant trustworthy on the next hop
+EDGE_TOKEN_HEADER = "X-Spotter-Edge-Token"
 ANON = "anon"
 
 TENANT_KEYS_ENV = "SPOTTER_TPU_TENANT_KEYS"
 TENANT_CONFIG_ENV = "SPOTTER_TPU_TENANT_CONFIG"
 TENANT_RPS_DEFAULT_ENV = "SPOTTER_TPU_TENANT_RPS_DEFAULT"
 TENANT_TOP_K_ENV = "SPOTTER_TPU_TENANT_TOP_K"
+TENANT_EDGE_SECRET_ENV = "SPOTTER_TPU_TENANT_EDGE_SECRET"
+TENANT_TRUST_HEADER_ENV = "SPOTTER_TPU_TENANT_TRUST_HEADER"
 
 DEFAULT_TOP_K = 8
 # burst defaults to 2x the sustained rate: one second of doubled arrival
@@ -88,6 +107,10 @@ DEFAULT_BURST_FACTOR = 2.0
 # hard cap on tracked per-tenant state: a flood inventing fresh tenant ids
 # must not grow memory without bound — least-recently-admitted evicted
 MAX_TRACKED_TENANTS = 1024
+# eviction backstop (REVIEW): a tenant whose inflight slot has not been
+# touched for this long is a leak (every handler releases in a finally,
+# so a live request can't look this stale) — reclaimable under pressure
+INFLIGHT_STALE_S = 600.0
 
 SHED_RATE = "rate"
 SHED_INFLIGHT = "inflight"
@@ -193,7 +216,14 @@ class _TenantState:
 
 class _Admitted:
     """Release handle for one admitted request: decrements the tenant's
-    inflight occupancy exactly once and feeds its per-tenant SLO burn."""
+    inflight occupancy exactly once and feeds its per-tenant SLO burn.
+
+    `good=None` releases the slot WITHOUT touching the SLO burn — the
+    abandoned-request path (client disconnect mid-await, uncaught handler
+    error) where no outcome was served: the leak guard must not let a
+    disconnect flood poison (or credit) anyone's budget. Idempotent, so
+    the handler's finally can release unconditionally and the normal
+    done() path still wins with the real outcome."""
 
     __slots__ = ("_plane", "tenant", "_released")
 
@@ -202,7 +232,7 @@ class _Admitted:
         self.tenant = tenant
         self._released = False
 
-    def release(self, good: bool = True) -> None:
+    def release(self, good: Optional[bool] = True) -> None:
         if self._released:
             return
         self._released = True
@@ -222,6 +252,8 @@ class TenantPlane:
         top_k: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
         rng: Optional[random.Random] = None,
+        edge_secret: Optional[str] = None,
+        trust_header: bool = False,
     ) -> None:
         config = config or {}
         self._key_map = dict(key_map or {})
@@ -249,29 +281,63 @@ class TenantPlane:
         )
         self._clock = clock
         self._rng = rng
+        self._edge_secret = edge_secret or None
+        self.trust_header = bool(trust_header)
         self._lock = threading.Lock()
         self._tenants: dict[str, _TenantState] = {}
         # plane-level totals (the admit_sheds_total-style counters the
         # contract test reads without depending on label bounding)
         self.admits_total = 0
         self.sheds_total = {SHED_RATE: 0, SHED_INFLIGHT: 0}
-        # DRR state: persistent per-tenant deficit so fairness holds
-        # ACROSS plan() calls, not just within one
-        self._drr_deficit: dict[str, float] = {}
+        # spoof visibility: claimed-but-unattested tenant headers that
+        # fell back to key/anon identity
+        self.header_rejects_total = 0
 
     # ---- identity ----
 
     def resolve(self, headers) -> str:
-        """Tenant id for a request: explicit header > API-key map > anon.
-        `headers` is any mapping with .get (aiohttp CIMultiDict works)."""
-        if headers is not None:
-            tenant = str(headers.get(TENANT_HEADER, "") or "").strip()
-            if tenant:
-                return tenant
-            key = str(headers.get(API_KEY_HEADER, "") or "").strip()
-            if key and key in self._key_map:
-                return str(self._key_map[key])
+        """Tenant id for a request. `headers` is any mapping with .get
+        (aiohttp CIMultiDict works).
+
+        The claimed `X-Spotter-Tenant` header is honored only when it is
+        ATTESTED (REVIEW): the edge token matches the shared secret, the
+        API-key map resolves the same tenant, or the deployment opted
+        into bare-header trust. Everything else resolves through the
+        API key alone, else to `anon` — one shared bucket, so a spoofer
+        rotating invented ids gains neither a victim's quota nor a fresh
+        burst, and cannot skew a victim's burn/occupancy accounting."""
+        if headers is None:
+            return ANON
+        key = str(headers.get(API_KEY_HEADER, "") or "").strip()
+        key_tenant = (
+            str(self._key_map[key])
+            if key and key in self._key_map
+            else None
+        )
+        claimed = str(headers.get(TENANT_HEADER, "") or "").strip()
+        if claimed:
+            if self.trust_header:
+                return claimed
+            if self._edge_secret is not None:
+                token = str(headers.get(EDGE_TOKEN_HEADER, "") or "")
+                if token and hmac.compare_digest(token, self._edge_secret):
+                    return claimed
+            if key_tenant is not None and claimed == key_tenant:
+                return key_tenant
+            with self._lock:
+                self.header_rejects_total += 1
+        if key_tenant is not None:
+            return key_tenant
         return ANON
+
+    def stamp(self, headers: dict, tenant: str) -> None:
+        """Stamp the RESOLVED identity onto forwarded headers (edge ->
+        replica hop), plus the attestation token when a shared secret is
+        configured — the next hop's plane then honors the id without
+        re-deriving it from client-controlled input."""
+        headers[TENANT_HEADER] = tenant
+        if self._edge_secret is not None:
+            headers[EDGE_TOKEN_HEADER] = self._edge_secret
 
     # ---- per-tenant config ----
 
@@ -317,11 +383,28 @@ class TenantPlane:
                     for t, s in self._tenants.items()
                     if s.inflight == 0
                 ]
+                if not idle:
+                    # backstop (REVIEW): every tracked tenant claims an
+                    # inflight slot — slots untouched past the stale
+                    # horizon are leaks (handlers release in a finally,
+                    # so live requests never look this old) and must not
+                    # make their tenants immortal
+                    horizon = self._clock() - INFLIGHT_STALE_S
+                    idle = [
+                        (s.last_seen, t)
+                        for t, s in self._tenants.items()
+                        if s.last_seen < horizon
+                    ]
                 if idle:
                     _, victim = min(idle)
                     del self._tenants[victim]
-                    self._drr_deficit.pop(victim, None)
-            st = self._tenants[tenant] = self._make_state(tenant)
+            st = self._make_state(tenant)
+            if len(self._tenants) < MAX_TRACKED_TENANTS:
+                self._tenants[tenant] = st
+            # else: full AND nothing evictable (MAX tenants all holding
+            # fresh inflight) — serve off transient untracked state so
+            # the memory bound is HARD; accounting for this tenant is
+            # degraded until pressure drops, never the map unbounded
         return st
 
     # ---- admission ----
@@ -367,12 +450,14 @@ class TenantPlane:
             self.admits_total += 1
             return _Admitted(self, tenant)
 
-    def _release(self, tenant: str, good: bool) -> None:
+    def _release(self, tenant: str, good: Optional[bool]) -> None:
         with self._lock:
             st = self._tenants.get(tenant)
             if st is None:
                 return
             st.inflight = max(st.inflight - 1, 0)
+            if good is None:  # abandoned: no outcome served, no burn
+                return
             if good:
                 st.burn.good()
             else:
@@ -441,10 +526,13 @@ class TenantPlane:
         distinct tenant the INPUT LIST is returned unchanged (identity,
         not a copy) — the bit-identity opt-out the scheduler tests pin.
 
-        Deficits persist across calls so fairness holds across plan()
-        rounds; a tenant absent from this round keeps nothing (deficit is
-        reset when its queue empties) so an idle tenant can't bank credit.
-        """
+        Fairness is PER CALL (classic DRR: a deficit resets the moment
+        its queue empties, and every queue drains within the call, so no
+        credit survives to the next one). That is the window that
+        matters: each plan() round is handed the whole pending backlog
+        and re-interleaves it, so a tenant wronged in one round is
+        re-ranked fairly from scratch in the next — nothing banks, for
+        anyone."""
         tenants: list[str] = []
         queues: dict[str, deque] = {}
         for it in items:
@@ -456,25 +544,23 @@ class TenantPlane:
             q.append(it)
         if len(tenants) <= 1:
             return items
-        with self._lock:
-            out: list = []
-            while len(out) < len(items):
-                for t in tenants:
-                    q = queues[t]
-                    if not q:
-                        continue
-                    # quantum = weight: a weight-4 tenant drains 4 items
-                    # per round for a weight-1 tenant's one
-                    self._drr_deficit[t] = (
-                        self._drr_deficit.get(t, 0.0) + self.weight(t)
-                    )
-                    while q and self._drr_deficit[t] >= 1.0:
-                        self._drr_deficit[t] -= 1.0
-                        out.append(q.popleft())
-                    if not q:
-                        # emptied: surrender leftover credit (no banking)
-                        self._drr_deficit.pop(t, None)
-            return out
+        deficit = {t: 0.0 for t in tenants}
+        out: list = []
+        while len(out) < len(items):
+            for t in tenants:
+                q = queues[t]
+                if not q:
+                    continue
+                # quantum = weight: a weight-4 tenant drains 4 items
+                # per round for a weight-1 tenant's one
+                deficit[t] += self.weight(t)
+                while q and deficit[t] >= 1.0:
+                    deficit[t] -= 1.0
+                    out.append(q.popleft())
+                if not q:
+                    # emptied: surrender leftover credit (no banking)
+                    deficit[t] = 0.0
+        return out
 
     # ---- observability ----
 
@@ -540,6 +626,9 @@ class TenantPlane:
             "tracked": len(rows),
             "admits_total": self.admits_total,
             "sheds_total": dict(self.sheds_total),
+            "header_rejects_total": self.header_rejects_total,
+            "trust_header": self.trust_header,
+            "edge_attested": self._edge_secret is not None,
             "default_rps": self.default_rps,
             "default_weight": self.default_weight,
             "top_k": self.top_k,
@@ -568,6 +657,25 @@ def _load_key_map(raw: str) -> dict:
         logger.warning("tenant key map %r is not an object; ignoring", raw)
         return {}
     return {str(k): str(v) for k, v in data.items()}
+
+
+def _load_edge_secret(raw: str) -> Optional[str]:
+    """`SPOTTER_TPU_TENANT_EDGE_SECRET` is preferably a PATH to a file
+    holding the shared attestation secret (secrets don't belong in
+    `ps e` output); a value that names no file is used literally (the
+    test/drill ergonomic case)."""
+    if os.path.isfile(raw):
+        try:
+            with open(raw) as f:
+                secret = f.read().strip()
+        except OSError as exc:
+            logger.warning(
+                "tenant edge secret file %r unreadable (%s); ignoring",
+                raw, exc,
+            )
+            return None
+        return secret or None
+    return raw
 
 
 def _load_config(raw: str) -> dict:
@@ -607,16 +715,23 @@ def from_env(
         logger.warning("%s=%r is not a number; using 0 (unlimited)",
                        TENANT_RPS_DEFAULT_ENV, rps_raw)
         default_rps = 0.0
+    secret_raw = os.environ.get(TENANT_EDGE_SECRET_ENV, "").strip()
+    trust_raw = os.environ.get(TENANT_TRUST_HEADER_ENV, "").strip()
     plane = TenantPlane(
         config=_load_config(cfg_raw) if cfg_raw else None,
         key_map=_load_key_map(keys_raw) if keys_raw else None,
         default_rps=default_rps,
         clock=clock,
+        edge_secret=_load_edge_secret(secret_raw) if secret_raw else None,
+        trust_header=trust_raw not in ("", "0"),
     )
     logger.warning(
         "TENANT ISOLATION ACTIVE: default_rps=%s weight=%s top_k=%d "
-        "(%d configured tenants, %d api keys)",
+        "(%d configured tenants, %d api keys; header %s)",
         plane.default_rps or "unlimited", plane.default_weight,
         plane.top_k, len(plane._tenant_cfg), len(plane._key_map),
+        "TRUSTED BARE" if plane.trust_header
+        else ("edge-attested" if plane._edge_secret is not None
+              else "untrusted (key/anon identity)"),
     )
     return plane

@@ -1551,12 +1551,16 @@ async def run_tenant_scenario(sc: TenantScenario) -> dict:
         urls.append(f"http://{server.host}:{server.port}")
 
     # frozen clock: buckets never refill, so admits == min(sent, burst)
-    # exactly; seeded rng pins the Retry-After jitter
+    # exactly; seeded rng pins the Retry-After jitter. trust_header: the
+    # drill clients model traffic whose identity an attested edge already
+    # resolved — identity spoofing has its own tests; these rows measure
+    # isolation BETWEEN known tenants
     plane = tenancy.TenantPlane(
         config=sc.config,
         default_rps=sc.default_rps,
         clock=lambda: 0.0,
         rng=random.Random(0),
+        trust_header=True,
     )
     pool = ReplicaPool(urls, health_interval_s=0.05, adaptive_hedge=True)
     aggregator = FleetAggregator(lambda: [], interval_s=0.0)  # determinism

@@ -115,17 +115,69 @@ def test_bucket_retry_after_tracks_deficit():
 # --------------------------------------------------- identity + admission
 
 
-def test_identity_precedence_header_then_key_then_anon():
+def test_identity_distrusts_bare_header():
+    """REVIEW: the tenant header is client-controlled — bare, it must
+    NOT be honored. Spoofers collapse to the key-resolved tenant or to
+    the one shared anon bucket (id rotation gains nothing)."""
     plane = _plane(key_map={"sekrit": "acme"})
-    assert plane.resolve({TENANT_HEADER: "explicit"}) == "explicit"
-    assert plane.resolve({"X-API-Key": "sekrit"}) == "acme"
-    # header beats the key map when both are present
+    # bare header: spoofable -> anon, and the reject is counted
+    assert plane.resolve({TENANT_HEADER: "victim"}) == ANON
+    assert plane.header_rejects_total == 1
+    # valid key + mismatched header: the AUTHENTICATED identity wins
     assert plane.resolve(
-        {TENANT_HEADER: "explicit", "X-API-Key": "sekrit"}
-    ) == "explicit"
+        {TENANT_HEADER: "victim", "X-API-Key": "sekrit"}
+    ) == "acme"
+    # header matching the key-resolved tenant is honored
+    assert plane.resolve(
+        {TENANT_HEADER: "acme", "X-API-Key": "sekrit"}
+    ) == "acme"
+    assert plane.resolve({"X-API-Key": "sekrit"}) == "acme"
     assert plane.resolve({"X-API-Key": "unknown"}) == ANON
     assert plane.resolve({}) == ANON
     assert plane.resolve(None) == ANON
+    snap = plane.snapshot()
+    assert snap["header_rejects_total"] == 2  # victim x2 above
+    assert snap["trust_header"] is False
+    assert snap["edge_attested"] is False
+
+
+def test_identity_edge_attestation_and_trust_opt_in():
+    plane = _plane(edge_secret="shh")
+    # the matching edge token attests the header (the edge->replica hop)
+    headers: dict = {}
+    plane.stamp(headers, "acme")
+    assert headers[TENANT_HEADER] == "acme"
+    assert headers[tenancy.EDGE_TOKEN_HEADER] == "shh"
+    assert plane.resolve(headers) == "acme"
+    # a wrong/missing token does not
+    assert plane.resolve(
+        {TENANT_HEADER: "acme", tenancy.EDGE_TOKEN_HEADER: "guess"}
+    ) == ANON
+    assert plane.resolve({TENANT_HEADER: "acme"}) == ANON
+    # explicit deployment opt-in (attested upstream: mTLS/mesh) trusts bare
+    trusting = _plane(trust_header=True)
+    assert trusting.resolve({TENANT_HEADER: "acme"}) == "acme"
+    assert trusting.header_rejects_total == 0
+    # stamp without a secret forwards the id alone
+    bare: dict = {}
+    trusting.stamp(bare, "acme")
+    assert bare == {TENANT_HEADER: "acme"}
+
+
+def test_from_env_edge_secret_and_trust(monkeypatch, tmp_path):
+    monkeypatch.setenv(TENANT_RPS_DEFAULT_ENV, "10")
+    secret_file = tmp_path / "edge.secret"
+    secret_file.write_text("filesecret\n")
+    monkeypatch.setenv(tenancy.TENANT_EDGE_SECRET_ENV, str(secret_file))
+    plane = tenancy.from_env()
+    assert plane is not None and plane._edge_secret == "filesecret"
+    assert plane.trust_header is False
+    # a non-path value is the literal secret (test/drill ergonomics)
+    monkeypatch.setenv(tenancy.TENANT_EDGE_SECRET_ENV, "inline-secret")
+    monkeypatch.setenv(tenancy.TENANT_TRUST_HEADER_ENV, "1")
+    plane = tenancy.from_env()
+    assert plane._edge_secret == "inline-secret"
+    assert plane.trust_header is True
 
 
 def test_rate_quota_sheds_with_retry_after():
@@ -167,6 +219,46 @@ def test_inflight_cap_sheds_and_release_frees():
     b.release()
     c.release()
     assert plane.inflight("loris") == 0
+
+
+def test_release_neutral_keeps_burn_untouched():
+    """REVIEW leak guard: the abandoned-request release (good=None) frees
+    the slot without recording an outcome — a disconnect flood must not
+    poison (or credit) a tenant's SLO burn."""
+    plane = _plane(config={"t": {"rps": 1000.0}})
+    adm = plane.try_admit("t")
+    adm.release(good=None)
+    assert plane.inflight("t") == 0
+    assert plane.snapshot()["tenants"]["t"]["slo_burn"] == 0.0
+    # still exactly-once: a later release with an outcome is a no-op
+    adm.release(good=False)
+    assert plane.snapshot()["tenants"]["t"]["slo_burn"] == 0.0
+    # contrast: a real bad outcome does burn
+    plane.try_admit("t").release(good=False)
+    assert plane.snapshot()["tenants"]["t"]["slo_burn"] > 0.0
+
+
+def test_stale_inflight_tenants_are_evictable():
+    """REVIEW backstop: leaked inflight slots (nothing live looks 10
+    minutes old) must not make their tenants immortal, or a disconnecting
+    tenant-id flood defeats the MAX_TRACKED_TENANTS memory bound."""
+    clock = FakeClock()
+    plane = _plane(clock=clock)
+    held = [
+        plane.try_admit(f"leak-{i:04d}")
+        for i in range(tenancy.MAX_TRACKED_TENANTS)
+    ]
+    assert len(plane._tenants) == tenancy.MAX_TRACKED_TENANTS
+    # every slot occupied and fresh: nothing evictable, the map holds
+    plane.try_admit("fresh-a").release()
+    assert len(plane._tenants) == tenancy.MAX_TRACKED_TENANTS
+    assert "fresh-a" not in plane._tenants
+    # past the stale horizon the leaked slots become reclaimable
+    clock.advance(tenancy.INFLIGHT_STALE_S + 1.0)
+    plane.try_admit("fresh-b").release()
+    assert "fresh-b" in plane._tenants
+    assert len(plane._tenants) <= tenancy.MAX_TRACKED_TENANTS
+    del held
 
 
 def test_over_share_and_top_occupancy():
@@ -284,11 +376,19 @@ def test_drr_weights_scale_service():
     ]
 
 
-def test_drr_deficit_surrendered_when_queue_empties():
+def test_drr_no_credit_survives_across_calls():
+    """Classic DRR: a deficit resets when its queue empties, and every
+    queue drains within a call — so NOTHING banks across calls (REVIEW:
+    fairness is per-call by design, and a round-1 leftover must not
+    reorder round 2)."""
     plane = _plane(config={"a": {"weight": 5.0}})
+    # a's 5-credit quantum drains only 1 item here; leftover must not bank
     plane.drr_order(_items("a", "b", "b", "b"), _tenant_of)
-    # a's 5-credit quantum drained only 1 item; the leftover must NOT bank
-    assert "a" not in plane._drr_deficit
+    fresh = _plane(config={"a": {"weight": 5.0}})
+    items = _items("b", "b", "b", "a", "a")
+    assert plane.drr_order(list(items), _tenant_of) == fresh.drr_order(
+        list(items), _tenant_of
+    )
 
 
 def test_scheduler_fifo_bit_identical_without_tenancy():
@@ -391,6 +491,9 @@ def test_standalone_quota_shed_contract(monkeypatch):
     monkeypatch.setenv(
         TENANT_CONFIG_ENV, '{"default": {"rps": 1, "burst": 1}}'
     )
+    # bare tenant headers are distrusted by default (REVIEW); this test
+    # reads the shed CONTRACT, so opt the replica into header identity
+    monkeypatch.setenv(tenancy.TENANT_TRUST_HEADER_ENV, "1")
 
     async def run():
         det = _stub_detector()
@@ -449,7 +552,10 @@ def test_router_quota_shed_contract(monkeypatch):
         replica_server = TestServer(make_app(detector=det))
         await replica_server.start_server()
         url = f"http://{replica_server.host}:{replica_server.port}"
-        plane = _plane(config={"abuser": {"rps": 1.0, "burst": 1.0}})
+        plane = _plane(
+            config={"abuser": {"rps": 1.0, "burst": 1.0}},
+            trust_header=True,  # clients model an attested upstream here
+        )
         pool = ReplicaPool([url], health_interval_s=0.05)
         app = make_router_app(
             pool,
@@ -486,6 +592,100 @@ def test_router_quota_shed_contract(monkeypatch):
         await det.aclose()
 
     asyncio.run(run())
+
+
+def test_router_releases_inflight_on_handler_crash():
+    """REVIEW leak guard at the router edge: an exception the handler
+    does NOT turn into a response (transport bug, cancellation) must
+    still free the tenant's inflight slot — else a disconnecting client
+    permanently 429-locks its tenant at max_inflight and skews
+    top_occupancy/over_share forever."""
+
+    async def run():
+        from spotter_tpu.obs.aggregate import FleetAggregator
+        from spotter_tpu.serving.replica_pool import ReplicaPool
+        from spotter_tpu.serving.router import make_router_app
+
+        plane = _plane(
+            config={"t": {"rps": 1000.0, "max_inflight": 1}},
+            trust_header=True,
+        )
+        pool = ReplicaPool(
+            ["http://127.0.0.1:1"], health_interval_s=1000.0
+        )
+
+        async def boom(*a, **kw):
+            raise RuntimeError("injected transport bug")
+
+        pool.request = boom  # not PoolExhaustedError: escapes the handler
+        app = make_router_app(
+            pool,
+            aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+            tenancy_plane=plane,
+        )
+        async with TestClient(TestServer(app)) as client:
+            for i in range(3):  # > max_inflight: only a leak would 429
+                r = await client.post(
+                    "/detect",
+                    json={"queries": ["sofa"]},
+                    headers={TENANT_HEADER: "t"},
+                )
+                assert r.status == 500, f"request {i}: {r.status}"
+            assert plane.inflight("t") == 0
+            # no outcome was served: the crash must not burn the budget
+            assert plane.snapshot()["tenants"]["t"]["slo_burn"] == 0.0
+        await pool.stop()
+
+    asyncio.run(run())
+
+
+def test_standalone_releases_inflight_on_handler_crash(monkeypatch):
+    """Same leak guard at the replica edge, via an admission-check path
+    that raises outside every except clause."""
+    monkeypatch.setenv(
+        TENANT_CONFIG_ENV,
+        '{"default": {"rps": 1000, "max_inflight": 1}}',
+    )
+    monkeypatch.setenv(tenancy.TENANT_TRUST_HEADER_ENV, "1")
+
+    async def run():
+        det = _stub_detector()
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected check_admission bug")
+
+        det.check_admission = boom
+        app = make_app(detector=det)
+        plane = app["tenancy"]
+        async with TestClient(TestServer(app)) as client:
+            for i in range(3):
+                r = await client.post(
+                    "/detect",
+                    json={"image_urls": ["http://example.com/a.jpg"]},
+                    headers={TENANT_HEADER: "t"},
+                )
+                assert r.status == 500, f"request {i}: {r.status}"
+            assert plane.inflight("t") == 0
+        await det.aclose()
+
+    asyncio.run(run())
+
+
+def test_retry_after_header_never_zero():
+    """REVIEW: sub-second tenant hints (rate-shed jitter floors at
+    0.05 s) must not render `Retry-After: 0` — that invites the
+    immediate retry the shed exists to push back. The precise float
+    rides in the JSON body instead."""
+    from spotter_tpu.serving.router import tenant_shed_response
+    from spotter_tpu.serving.standalone import _shed_response
+
+    exc = TenantQuotaError("t", tenancy.SHED_RATE, retry_after_s=0.07)
+    for resp in (tenant_shed_response(exc), _shed_response(exc)):
+        assert int(resp.headers["Retry-After"]) >= 1
+        assert json.loads(resp.body)["retry_after_s"] == 0.07
+    # larger hints ceil, not truncate
+    slow = TenantQuotaError("t", tenancy.SHED_RATE, retry_after_s=3.2)
+    assert tenant_shed_response(slow).headers["Retry-After"] == "4"
 
 
 def test_shed_contract_table_across_surfaces(monkeypatch):
