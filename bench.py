@@ -2861,6 +2861,497 @@ def tenant_storm_bench(args) -> int:
     return 0 if passed else 1
 
 
+def multi_model_bench(args) -> int:
+    """Model-multiplexed serverless autoscaling, measured (ISSUE 20
+    acceptance): one Zipf-over-models workload over all seven zoo
+    families served twice on identical stub topologies behind the REAL
+    fleet edge (FleetController + AutoscalerBrain + model routing):
+
+    1. **Static fleet**: every family pool pinned at --mm-static-size
+       (min == max, the brain routes but cannot resize) — the
+       provision-for-peak baseline for goodput AND chip-seconds.
+    2. **Autoscaled fleet**: the default family starts at 1, every other
+       family at ZERO with scale-to-zero armed; the brain wakes pools on
+       routed demand (cold restore under the request), scales on live
+       signals, and reclaims idle pools. Chip-seconds are integrated
+       from sampled ready-chips (ready members x tp x dp) over the
+       phase.
+    3. **Idle overhead**: brain attached-but-idle vs absent over one
+       single-pool fleet each, request-level paired interleave (the
+       --fleet-obs protocol). Gate: trimmed-mean paired p50 delta < 1%.
+
+    Gates: autoscaled goodput >= 90% of static, autoscaled
+    chip-seconds <= 50% of static, every cold wake ready in < 15 s,
+    ZERO client failures in both serving phases, overhead < 1%.
+    Prints ONE JSON line accepted by tools/bench_compare.py; exits
+    non-zero when any gate fails.
+    """
+    import asyncio
+    import random
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving.autoscale import (
+        AutoscalerBrain,
+        ModelPool,
+        pool_shape,
+    )
+    from spotter_tpu.serving.fleet import (
+        FleetController,
+        PoolSpec,
+        make_fleet_app,
+    )
+    from spotter_tpu.testing.chaos_matrix import _ScaleMember
+
+    # the seven zoo families in workload-popularity order (rank 1 first);
+    # explicit list rather than model_pools_from_registry so the bench
+    # stays jax-free (the registry import pulls the zoo's model builders)
+    families = [
+        "rtdetr", "yolos", "owlvit", "detr", "dab_detr",
+        "conditional_detr", "deformable_detr",
+    ]
+    default_family = "rtdetr"
+    open_vocab_family = "owlvit"
+    static_size = args.mm_static_size
+    max_size = args.mm_max_size
+    phase_s = args.mm_phase_s
+    rate_hz = args.mm_rate_hz
+    service_s = args.mm_service_ms / 1000.0
+    cold_start_s = args.mm_cold_start_s
+    goodput_gate = 0.90
+    chips_gate = 0.50
+    cold_gate_s = 15.0
+    overhead_gate_pct = 1.0
+    urls_cycle = [f"http://mm.example.com/img-{i}.jpg" for i in range(32)]
+
+    # ONE pre-drawn Zipf arrival tape replayed by both serving phases —
+    # the comparison is fleet-shape-only, never workload sampling noise
+    weights = [1.0 / (rank + 1) ** args.mm_zipf_a
+               for rank in range(len(families))]
+    tape = random.Random(0).choices(
+        families, weights=weights, k=max(int(rate_hz * phase_s), 1)
+    )
+    interval = phase_s / len(tape)
+
+    async def build_members(prefix: str):
+        """One pre-started stock of max_size members per family; the
+        spawner pops the next non-serving one and 'boots' it
+        (cold_start_s of 503 /healthz — the compile-cache-restore
+        window)."""
+        stocks: dict[str, list[_ScaleMember]] = {}
+        members: list[_ScaleMember] = []
+        for fam in families:
+            stock = []
+            for i in range(max_size):
+                m = _ScaleMember(
+                    f"{prefix}-{fam}-m{i}", fam,
+                    service_s=service_s, cold_start_s=cold_start_s,
+                )
+                await m.start()
+                stock.append(m)
+                members.append(m)
+            stocks[fam] = stock
+        return stocks, members
+
+    def make_fleet(stocks, autoscaled: bool):
+        specs, model_pools = [], []
+        for fam in families:
+            def spawner(name=fam):
+                for m in stocks[name]:
+                    if not m._serving:
+                        return m.spawn()
+                raise RuntimeError(f"pool {name}: stock exhausted")
+
+            is_default = fam == default_family
+            if autoscaled:
+                initial = 1 if is_default else 0
+                lo, hi = (1 if is_default else 0), max_size
+                stz = 0.0 if is_default else args.mm_scale_to_zero_s
+            else:
+                initial, lo, hi, stz = (
+                    static_size, static_size, static_size, 0.0
+                )
+            tp, dp = pool_shape(fam)
+            specs.append(PoolSpec(
+                fam, spawner=spawner, target_size=initial,
+                scale_to_zero_s=stz,
+            ))
+            model_pools.append(ModelPool(
+                model=fam, open_vocab=fam == open_vocab_family,
+                tp=tp, dp=dp, min_size=lo, max_size=hi,
+                default=is_default,
+            ))
+        controller = FleetController(
+            specs,
+            tick_s=0.05,
+            restore_wait_s=10.0,
+            unavailable_wait_s=2.0,
+            respawn_base_s=0.05,
+            pool_kwargs=dict(
+                eject_threshold=1, backoff_base_s=0.05,
+                backoff_max_s=0.2, health_interval_s=0.05,
+            ),
+        )
+        brain = AutoscalerBrain(
+            controller, model_pools, tick_s=0.05, down_steps=3,
+        )
+        app = make_fleet_app(
+            controller,
+            aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+            autoscaler=brain,
+        )
+        chips = {mp.model: mp.chips_per_member for mp in model_pools}
+        return controller, brain, app, chips
+
+    async def serve_phase(autoscaled: bool) -> dict:
+        stocks, members = await build_members(
+            "mm-auto" if autoscaled else "mm-static"
+        )
+        controller, brain, app, chips = make_fleet(stocks, autoscaled)
+        events: list[tuple[float, int, float, bool]] = []
+        chip_acc = {"chip_s": 0.0, "samples": 0, "peak": 0.0}
+        stop = {"flag": False}
+
+        def ready_chips() -> float:
+            now = time.monotonic()
+            return float(sum(
+                controller.pools[fam].member_states(now).get("ready", 0)
+                * chips[fam]
+                for fam in families
+            ))
+
+        async def sampler() -> None:
+            loop = asyncio.get_running_loop()
+            last = loop.time()
+            while not stop["flag"]:
+                await asyncio.sleep(0.02)
+                now = loop.time()
+                c = ready_chips()
+                chip_acc["chip_s"] += c * (now - last)
+                chip_acc["samples"] += 1
+                chip_acc["peak"] = max(chip_acc["peak"], c)
+                last = now
+
+        async with TestClient(TestServer(app)) as client:
+            floor = {
+                fam: (static_size if not autoscaled
+                      else (1 if fam == default_family else 0))
+                for fam in families
+            }
+            deadline = asyncio.get_running_loop().time() + 15.0
+            while not all(
+                controller.pools[f].member_states(time.monotonic()).get(
+                    "ready", 0
+                ) >= n
+                for f, n in floor.items()
+            ):
+                if asyncio.get_running_loop().time() > deadline:
+                    raise TimeoutError("initial pools not ready")
+                await asyncio.sleep(0.02)
+
+            async def one(fam: str, i: int) -> None:
+                # the open-vocab family arrives as bare `queries` (the
+                # routing fact under test: prompts imply OWL-ViT);
+                # everything else names its model in the payload
+                payload: dict = {
+                    "image_urls": [urls_cycle[i % len(urls_cycle)]]
+                }
+                if fam == open_vocab_family:
+                    payload["queries"] = ["a solar panel", "a hot tub"]
+                else:
+                    payload["model"] = fam
+                t0 = time.perf_counter()
+                resp = await client.post("/detect", json=payload)
+                body = await resp.json()
+                t1 = time.perf_counter()
+                routed_ok = (
+                    resp.status == 200 and body.get("pool") == fam
+                )
+                events.append((t0, resp.status, t1 - t0, routed_ok))
+
+            # warm the shared edge path symmetrically (connection +
+            # first-request effects on the default pool only — warming
+            # every family would pre-boot the cold pools this phase
+            # exists to measure)
+            for i in range(8):
+                await one(default_family, i)
+            events.clear()
+            gc.collect()
+
+            inflight: set = set()
+            sample_task = asyncio.create_task(sampler())
+            t0 = time.perf_counter()
+            for i, fam in enumerate(tape):
+                task = asyncio.create_task(one(fam, i))
+                inflight.add(task)
+                task.add_done_callback(inflight.discard)
+                await asyncio.sleep(interval)
+            t1 = time.perf_counter()
+            await asyncio.gather(*inflight, return_exceptions=True)
+            stop["flag"] = True
+            await sample_task
+
+            # settle: restore bookkeeping lands on the controller tick
+            # AFTER requests already completed (request() re-checks the
+            # replica pool directly) — wait before snapshotting
+            settle = asyncio.get_running_loop().time() + 2.0
+            while any(fp.restoring for fp in controller.pools.values()):
+                if asyncio.get_running_loop().time() > settle:
+                    break
+                await asyncio.sleep(0.05)
+            brain_snap = brain.snapshot()
+            fleet_snap = controller.snapshot()
+
+        for m in members:
+            await m.close()
+
+        dur = max(t1 - t0, 1e-9)
+        good = [e for e in events if e[1] == 200]
+        lat = sorted(e[2] * 1e3 for e in good)
+        timed = [
+            p["time_to_ready_s"]
+            for p in fleet_snap["pools"].values()
+            if p["time_to_ready_s"] is not None and p["restores_total"] > 0
+        ]
+        return {
+            "requests": len(events),
+            "failures": len(events) - len(good),
+            "misrouted": sum(1 for e in good if not e[3]),
+            "goodput_rps": len(good) / dur,
+            "p50_ms": float(np.percentile(lat, 50)) if lat else 0.0,
+            "p99_ms": float(np.percentile(lat, 99)) if lat else 0.0,
+            "duration_s": dur,
+            "chip_s": chip_acc["chip_s"],
+            "avg_chips": chip_acc["chip_s"] / dur,
+            "peak_chips": chip_acc["peak"],
+            "wakes": brain_snap["wakes_total"],
+            "scale_ups": brain_snap["scale_ups_total"],
+            "scale_downs": brain_snap["scale_downs_total"],
+            "restores": sum(
+                p["restores_total"] for p in fleet_snap["pools"].values()
+            ),
+            "time_to_ready_s": timed,
+        }
+
+    async def overhead() -> dict:
+        """Brain attached-but-idle vs absent, ONE single-pool fleet
+        each, request-level paired interleave with per-pair order
+        flipping (the --fleet-obs / tenant-storm protocol)."""
+
+        async def mini_fleet(prefix: str, autoscaler: bool):
+            m = _ScaleMember(
+                f"{prefix}-m0", default_family,
+                service_s=service_s, cold_start_s=0.0,
+            )
+            await m.start()
+
+            def spawner():
+                return m.spawn()
+
+            controller = FleetController(
+                [PoolSpec(default_family, spawner=spawner, target_size=1)],
+                tick_s=0.05,
+                pool_kwargs=dict(
+                    eject_threshold=1, backoff_base_s=0.05,
+                    backoff_max_s=0.2, health_interval_s=0.05,
+                ),
+            )
+            brain = None
+            if autoscaler:
+                brain = AutoscalerBrain(
+                    controller,
+                    [ModelPool(model=default_family, min_size=1,
+                               max_size=1, default=True)],
+                    tick_s=0.25,
+                )
+            app = make_fleet_app(
+                controller,
+                aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+                autoscaler=brain,
+            )
+            return m, controller, app
+
+        m_off, ctrl_off, app_off = await mini_fleet("mm-ovh-off", False)
+        m_on, ctrl_on, app_on = await mini_fleet("mm-ovh-on", True)
+        off: list[float] = []
+        on: list[float] = []
+        pair_deltas: dict[bool, list[float]] = {False: [], True: []}
+        async with TestClient(TestServer(app_off)) as c_off, TestClient(
+            TestServer(app_on)
+        ) as c_on:
+            deadline = asyncio.get_running_loop().time() + 15.0
+            while not all(
+                c.pools[default_family].member_states(
+                    time.monotonic()
+                ).get("ready", 0) >= 1
+                for c in (ctrl_off, ctrl_on)
+            ):
+                if asyncio.get_running_loop().time() > deadline:
+                    raise TimeoutError("overhead fleets not ready")
+                await asyncio.sleep(0.02)
+
+            async def one_request(client, i: int) -> float:
+                t0 = time.perf_counter()
+                resp = await client.post(
+                    "/detect",
+                    json={
+                        "image_urls": [urls_cycle[i % len(urls_cycle)]]
+                    },
+                )
+                await resp.read()
+                assert resp.status == 200, f"HTTP {resp.status}"
+                return time.perf_counter() - t0
+
+            for i in range(args.mm_overhead_requests):
+                await one_request(c_off, i)
+                await one_request(c_on, i)
+            for r in range(args.mm_overhead_rounds):
+                for i in range(args.mm_overhead_requests):
+                    # per-pair order flip: each off/on pair runs
+                    # back-to-back under the same instantaneous CPU/GC
+                    # state, and first/second warmth alternates — the
+                    # per-order-class means below cancel it exactly
+                    order = (
+                        (False, True) if (r + i) % 2 == 0
+                        else (True, False)
+                    )
+                    lat: dict[bool, float] = {}
+                    for armed in order:
+                        lat[armed] = await one_request(
+                            c_on if armed else c_off, i
+                        )
+                    off.append(lat[False])
+                    on.append(lat[True])
+                    pair_deltas[order[0]].append(lat[True] - lat[False])
+        await m_off.close()
+        await m_on.close()
+        p50_off = float(np.median(off)) if off else 0.0
+
+        def _trimmed_mean(xs: list[float]) -> float:
+            trim = len(xs) // 10
+            core = (
+                sorted(xs)[trim: len(xs) - trim]
+                if len(xs) > 2 * trim
+                else xs
+            )
+            return float(np.mean(core)) if core else 0.0
+
+        classes = [v for v in pair_deltas.values() if v]
+        delta_pct = (
+            float(np.mean([_trimmed_mean(v) for v in classes]))
+            / p50_off * 100.0
+            if classes and p50_off > 0
+            else 0.0
+        )
+        return {
+            "p50_off_ms": p50_off * 1e3,
+            "p50_on_ms": float(np.median(on)) * 1e3 if on else 0.0,
+            "pairs": len(off),
+            "delta_pct": delta_pct,
+        }
+
+    # overhead first: the paired rounds want the quietest CPU state
+    ovh = asyncio.run(overhead())
+    static = asyncio.run(serve_phase(autoscaled=False))
+    auto = asyncio.run(serve_phase(autoscaled=True))
+
+    goodput_ratio = (
+        auto["goodput_rps"] / static["goodput_rps"]
+        if static["goodput_rps"] > 0
+        else 0.0
+    )
+    chips_ratio = (
+        auto["chip_s"] / static["chip_s"] if static["chip_s"] > 0 else 1.0
+    )
+    cold = auto["time_to_ready_s"]
+    gates = {
+        "goodput_within_10pct": goodput_ratio >= goodput_gate,
+        "chips_at_most_half": chips_ratio <= chips_gate,
+        "cold_ready_under_15s": bool(cold) and max(cold) < cold_gate_s,
+        "zero_client_failures": (
+            static["failures"] == 0 and auto["failures"] == 0
+        ),
+        "zero_misroutes": (
+            static["misrouted"] == 0 and auto["misrouted"] == 0
+        ),
+        "autoscaler_actually_woke": auto["wakes"] >= 1,
+        "overhead_under_1pct": ovh["delta_pct"] < overhead_gate_pct,
+    }
+    passed = all(gates.values())
+    print(
+        f"# multi-model: Zipf(a={args.mm_zipf_a:g}) x {len(tape)} "
+        f"requests over {len(families)} families at {rate_hz:g}/s: "
+        f"autoscaled goodput {auto['goodput_rps']:.1f}/s vs static "
+        f"{static['goodput_rps']:.1f}/s ({goodput_ratio * 100:.1f}%, "
+        f"gate >= 90%), chip-seconds {auto['chip_s']:.1f} vs "
+        f"{static['chip_s']:.1f} ({chips_ratio * 100:.1f}%, gate <= "
+        f"50%), avg chips {auto['avg_chips']:.1f} vs "
+        f"{static['avg_chips']:.1f} (peak {auto['peak_chips']:.0f} vs "
+        f"{static['peak_chips']:.0f}); {auto['wakes']} wakes, "
+        f"{auto['restores']} restores, worst cold-to-ready "
+        f"{max(cold) if cold else float('nan'):.2f} s (gate < 15); "
+        f"failures static {static['failures']} / autoscaled "
+        f"{auto['failures']} (gate 0); autoscaled p50 "
+        f"{auto['p50_ms']:.1f} ms p99 {auto['p99_ms']:.1f} ms vs static "
+        f"{static['p50_ms']:.1f}/{static['p99_ms']:.1f}; idle-brain "
+        f"overhead {ovh['delta_pct']:+.2f}% of p50 (off "
+        f"{ovh['p50_off_ms']:.3f} -> on {ovh['p50_on_ms']:.3f} ms, "
+        f"{ovh['pairs']} pairs, gate < 1%)",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": (
+            f"multi-model autoscaling chip-seconds vs static fleet: one "
+            f"Zipf(a={args.mm_zipf_a:g}) workload over "
+            f"{len(families)} model families ({len(tape)} requests at "
+            f"{rate_hz:g}/s, stub members, open-vocab family routed by "
+            f"bare `queries`) served by a scale-to-zero autoscaled "
+            f"fleet vs the same fleet pinned at {static_size}/pool "
+            f"(gates: goodput >= 90% of static, chip-seconds <= 50%, "
+            f"every cold wake ready < 15 s, 0 client failures, 0 "
+            f"misroutes, idle-brain overhead < 1% paired p50)"
+        ),
+        "value": round(chips_ratio * 100.0, 2),
+        "unit": "percent_of_static_chip_seconds",
+        "vs_baseline": None,
+        "families": len(families),
+        "requests_per_phase": len(tape),
+        "zipf_a": args.mm_zipf_a,
+        "rate_hz": rate_hz,
+        "goodput_static_rps": round(static["goodput_rps"], 1),
+        "goodput_autoscaled_rps": round(auto["goodput_rps"], 1),
+        "goodput_ratio_pct": round(goodput_ratio * 100.0, 2),
+        "chip_s_static": round(static["chip_s"], 2),
+        "chip_s_autoscaled": round(auto["chip_s"], 2),
+        "avg_chips_static": round(static["avg_chips"], 2),
+        "avg_chips_autoscaled": round(auto["avg_chips"], 2),
+        "peak_chips_autoscaled": auto["peak_chips"],
+        "p50_static_ms": round(static["p50_ms"], 3),
+        "p50_autoscaled_ms": round(auto["p50_ms"], 3),
+        "p99_static_ms": round(static["p99_ms"], 3),
+        "p99_autoscaled_ms": round(auto["p99_ms"], 3),
+        "failures_static": static["failures"],
+        "failures_autoscaled": auto["failures"],
+        "misrouted_static": static["misrouted"],
+        "misrouted_autoscaled": auto["misrouted"],
+        "wakes": auto["wakes"],
+        "scale_ups": auto["scale_ups"],
+        "scale_downs": auto["scale_downs"],
+        "restores": auto["restores"],
+        "cold_time_to_ready_s": (
+            round(max(cold), 3) if cold else None
+        ),
+        "overhead_delta_pct": round(ovh["delta_pct"], 3),
+        "overhead_p50_off_ms": round(ovh["p50_off_ms"], 3),
+        "overhead_p50_on_ms": round(ovh["p50_on_ms"], 3),
+        "gates": gates,
+        "pass": passed,
+    }
+    print(json.dumps(result))
+    return 0 if passed else 1
+
+
 def rollout_drill_bench(args) -> int:
     """Safe deployment plane, measured (ISSUE 15 acceptance): model-free
     stub fleets behind the REAL router + ReplicaPool + FleetAggregator +
@@ -5059,6 +5550,69 @@ def main() -> int:
         "each side to drop below the gate width",
     )
     parser.add_argument(
+        "--multi-model",
+        action="store_true",
+        help="run the model-multiplexed autoscaling drill bench instead "
+        "(CPU ok, model-free): one Zipf-over-models workload over all 7 "
+        "zoo families served by a scale-to-zero autoscaled fleet vs the "
+        "same fleet statically pinned per pool; gates autoscaled goodput "
+        ">= 90% of static at <= 50% of static chip-seconds, every cold "
+        "wake ready < 15 s, 0 client failures, 0 misroutes, and the "
+        "idle-brain paired-p50 overhead < 1%; exits non-zero when any "
+        "gate fails",
+    )
+    parser.add_argument(
+        "--mm-phase-s", type=float, default=8.0,
+        help="duration of each serving phase (static and autoscaled run "
+        "the SAME pre-drawn arrival tape); long enough for the brain to "
+        "wake cold families, scale the default pool, and reclaim idle "
+        "pools inside one window",
+    )
+    parser.add_argument(
+        "--mm-rate-hz", type=float, default=60.0,
+        help="fixed-rate OPEN-loop total arrival rate split over "
+        "families by the Zipf draw — offered load that does not back "
+        "off while a cold pool restores, so the goodput ratio reads "
+        "fleet shape, not client politeness",
+    )
+    parser.add_argument(
+        "--mm-zipf-a", type=float, default=1.6,
+        help="Zipf exponent over the 7 families (popularity rank order: "
+        "rtdetr, yolos, owlvit, detr, dab_detr, conditional_detr, "
+        "deformable_detr); 1.6 gives the head family ~56% of traffic "
+        "with every tail family still drawing enough requests to force "
+        "a cold wake",
+    )
+    parser.add_argument("--mm-service-ms", type=float, default=2.0)
+    parser.add_argument(
+        "--mm-static-size", type=int, default=2,
+        help="members per pool in the provision-for-peak static "
+        "baseline (7 pools x this x tp x dp chips, always on)",
+    )
+    parser.add_argument(
+        "--mm-max-size", type=int, default=2,
+        help="autoscaled per-pool member ceiling (and the pre-started "
+        "stub stock depth per pool)",
+    )
+    parser.add_argument(
+        "--mm-cold-start-s", type=float, default=0.25,
+        help="stub member /healthz 503 window after each spawn — the "
+        "compile-cache-restore cost a cold wake pays",
+    )
+    parser.add_argument(
+        "--mm-scale-to-zero-s", type=float, default=0.8,
+        help="idle window before a non-default pool is reclaimed to "
+        "zero in the autoscaled phase; short enough that reclaim "
+        "actually happens inside --mm-phase-s",
+    )
+    parser.add_argument("--mm-overhead-requests", type=int, default=120)
+    parser.add_argument(
+        "--mm-overhead-rounds", type=int, default=16,
+        help="paired off/on rounds for the idle-brain overhead gate "
+        "(the --fleet-obs calibration: ~2k pairs for sub-1% p50 "
+        "resolution)",
+    )
+    parser.add_argument(
         "--rollout-drill",
         action="store_true",
         help="run the deployment drill bench instead (CPU ok, model-free): "
@@ -5180,6 +5734,8 @@ def main() -> int:
         return integrity_drill_bench(args)
     if args.tenant_storm:
         return tenant_storm_bench(args)
+    if args.multi_model:
+        return multi_model_bench(args)
     if args.rollout_drill:
         return rollout_drill_bench(args)
     if args.controller_crash:

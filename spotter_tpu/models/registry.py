@@ -34,17 +34,50 @@ def register(family: ModelFamily) -> None:
     MODEL_REGISTRY[family.name] = family
 
 
+def match_score(key: str, matches: tuple[str, ...]):
+    """Best (start, -length) score of any pattern inside `key`, or None.
+
+    Lower is better: the pattern that begins earliest in the name wins, and
+    among patterns starting at the same offset the longest wins. This makes
+    resolution order-independent — "dab-detr-resnet-50" contains both
+    "dab-detr" (at 0) and the plain-detr pattern "detr-resnet" (at 4), and
+    the earliest-start rule picks the specific family no matter which
+    registered first. Pure longest-substring would misroute that name
+    (len("detr-resnet") > len("dab-detr")); earliest-start-then-longest
+    resolves every zoo family correctly with no ordering contract.
+    """
+    best = None
+    for m in matches:
+        i = key.find(m)
+        if i < 0:
+            continue
+        score = (i, -len(m))
+        if best is None or score < best:
+            best = score
+    return best
+
+
 def family_for(model_name: str) -> ModelFamily:
-    """Resolve MODEL_NAME to its registered family (substring match, the
-    registration-order precedence the zoo relies on)."""
+    """Resolve MODEL_NAME to its registered family.
+
+    Substring match scored by `match_score`: most-specific wins
+    (earliest match start, then longest pattern), independent of
+    registration order. Ties on identical scores keep the first
+    registered family, so resolution is fully deterministic.
+    """
     # Lazy: zoo pulls in the engine (jax/PIL); config-only consumers of
     # spotter_tpu.models must not pay that import.
     from spotter_tpu.models import zoo  # noqa: F401  (self-registers families)
 
     key = model_name.lower()
+    best_family = None
+    best_score = None
     for family in MODEL_REGISTRY.values():
-        if any(m in key for m in family.matches):
-            return family
+        score = match_score(key, family.matches)
+        if score is not None and (best_score is None or score < best_score):
+            best_family, best_score = family, score
+    if best_family is not None:
+        return best_family
     raise ValueError(
         f"MODEL_NAME '{model_name}' does not match any registered family: "
         f"{[f.matches for f in MODEL_REGISTRY.values()]}"

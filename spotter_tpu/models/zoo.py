@@ -528,9 +528,10 @@ from spotter_tpu.parallel.sharding import (  # noqa: E402  (after model imports)
     VIT_TP_RULES,
 )
 
+# Registration order carries no precedence: family_for resolves ambiguous
+# names ("dab-detr-resnet-50" contains both "dab-detr" and "detr-resnet")
+# by earliest-start-then-longest match, so the specific family always wins.
 register(
-    # must precede the plain-detr family: "conditional-detr-resnet-50"
-    # also contains the "detr-resnet" substring
     ModelFamily(
         name="conditional_detr",
         matches=("conditional-detr", "conditional_detr"),
@@ -539,7 +540,6 @@ register(
     )
 )
 register(
-    # must precede plain-detr: "dab-detr-resnet-50" contains "detr-resnet"
     ModelFamily(
         name="dab_detr", matches=("dab-detr", "dab_detr"), build=_build_dab_detr,
         tp_rules=tuple(TRANSFORMER_TP_RULES),
@@ -572,8 +572,7 @@ register(ModelFamily(
     tp_rules=tuple(VIT_TP_RULES),
 ))
 register(
-    # plain DETR (+ Table-Transformer, a pre-norm DETR with identical keys);
-    # matched AFTER rtdetr so "rtdetr*" names never land here
+    # plain DETR (+ Table-Transformer, a pre-norm DETR with identical keys)
     ModelFamily(
         name="detr",
         matches=("detr-resnet", "detr_resnet", "table-transformer", "table_transformer"),

@@ -460,15 +460,20 @@ class FleetController:
         payload: dict,
         cls: Optional[str] = None,
         headers: Optional[dict] = None,
+        pool: Optional[str] = None,
     ):
         """Route one classed request through its pool, waking a
         scaled-to-zero pool on the way. Bulk requests tolerate a bounded
         wait for a restoring/stormed pool; SLO requests fail fast (the
-        caller turns PoolExhaustedError subclasses into 503 + Retry-After)."""
+        caller turns PoolExhaustedError subclasses into 503 + Retry-After).
+        `pool` (ISSUE 20) overrides class routing with a named pool — the
+        model-multiplexed edge resolves the model FIRST and pins the
+        request to that family's pool; the class still drives wait/accounting
+        behavior."""
         if cls not in (SLO, BULK):
             cls = self.default_class
         self.class_requests[cls] += 1
-        fp = self.pool_for_class(cls)
+        fp = self.pools[pool] if pool is not None else self.pool_for_class(cls)
         fp.last_used = time.monotonic()
         if not fp.pool.has_available():
             self._maybe_restore(fp)
@@ -736,6 +741,7 @@ def make_fleet_app(
     aggregator: FleetAggregator | None = None,
     reconciler=None,
     tenancy_plane=None,
+    autoscaler=None,
 ) -> web.Application:
     """The fleet edge: /detect classifies (header/payload) and routes
     through the controller; /metrics serves the pool gauges the storm bench
@@ -754,7 +760,12 @@ def make_fleet_app(
     `tenancy.from_env()` — None when unconfigured) arms per-tenant edge
     quotas exactly like the plain router: over-quota tenants shed 429
     with a tenant-scoped Retry-After before the body is read, and the
-    resolved id rides downstream in X-Spotter-Tenant."""
+    resolved id rides downstream in X-Spotter-Tenant. `autoscaler` (ISSUE
+    20, default None) attaches an `autoscale.AutoscalerBrain`: /detect
+    resolves a MODEL pool (X-Spotter-Model header / `model` payload key /
+    `queries` -> open-vocab pool) before class routing, unplaceable
+    requests get a structured 400 naming the registry, and /metrics grows
+    the `autoscale` per-model-pool block fleet_top renders."""
     from spotter_tpu.serving import tenancy
 
     if aggregator is None:
@@ -766,12 +777,17 @@ def make_fleet_app(
     app["edge_limiter"] = limiter
     app["fleet_aggregator"] = aggregator
     app["tenancy"] = tenancy_plane
+    app["autoscaler"] = autoscaler
 
     async def on_startup(app: web.Application) -> None:
         await controller.start()
         await aggregator.start()
+        if autoscaler is not None:
+            await autoscaler.start()
 
     async def on_cleanup(app: web.Application) -> None:
+        if autoscaler is not None:
+            await autoscaler.stop()
         await aggregator.stop()
         await controller.stop()
 
@@ -783,6 +799,7 @@ def make_fleet_app(
         trace, request_id = obs_http.begin_http_trace(request)
         tenant = None
         tadm = None
+        mtrack = None
 
         def done(resp: web.Response) -> web.Response:
             # per-tenant occupancy + SLO accounting (ISSUE 19)
@@ -790,6 +807,9 @@ def make_fleet_app(
                 tadm.release(
                     good=resp.status not in (429, 503) and resp.status < 500
                 )
+            # per-model-pool edge accounting (ISSUE 20)
+            if mtrack is not None:
+                mtrack.done(resp.status)
             return obs_http.finish_http_trace(
                 trace, request_id, resp, server_timing=True
             )
@@ -814,6 +834,22 @@ def make_fleet_app(
                 cls, payload = classify_request(
                     request.headers, payload, default=controller.default_class
                 )
+            model_pool = None
+            if autoscaler is not None:
+                # model-multiplexed routing (ISSUE 20): resolve the MODEL
+                # pool before class routing; unplaceable requests are
+                # structured 400s naming the registry, through done() so
+                # the request id echoes like every other shed
+                from spotter_tpu.serving.autoscale import ModelRoutingError
+                from spotter_tpu.serving.router import model_routing_response
+
+                try:
+                    model_pool, payload = autoscaler.route(
+                        request.headers, payload
+                    )
+                except ModelRoutingError as exc:
+                    return done(model_routing_response(exc))
+                mtrack = autoscaler.track(model_pool)
             adm = None
             if limiter is not None:
                 adm = limiter.try_admit(cls)
@@ -834,7 +870,7 @@ def make_fleet_app(
             t_fwd = time.monotonic()
             try:
                 resp = await controller.request(
-                    "/detect", payload, cls, headers=headers
+                    "/detect", payload, cls, headers=headers, pool=model_pool
                 )
             except PoolExhaustedError as exc:
                 return done(
@@ -876,6 +912,8 @@ def make_fleet_app(
             # no-outcome release never touches the SLO burn.
             if tadm is not None:
                 tadm.release(good=None)
+            if mtrack is not None:
+                mtrack.done(None)
 
     async def healthz(request: web.Request) -> web.Response:
         available = {
@@ -916,6 +954,10 @@ def make_fleet_app(
         # tenant isolation plane (ISSUE 19): bounded top-K per-tenant rows
         if tenancy_plane is not None:
             snap["tenants"] = tenancy_plane.metrics_view()
+        # model-multiplexed autoscaler (ISSUE 20): per-model-pool desired/
+        # ready, last decision + reason, restore timing — fleet_top's rows
+        if autoscaler is not None:
+            snap["autoscale"] = autoscaler.snapshot()
         return obs_http.metrics_response(request, snap)
 
     async def debug_tenants(request: web.Request) -> web.Response:

@@ -433,17 +433,17 @@ def healthz_block(reconciler: Optional["Reconciler"]) -> dict:
 # ---- standalone controller process (the drill target) ----
 
 
-def parse_pool_args(pairs: list[str]) -> dict[str, int]:
+def parse_pool_args(pairs: list[str], flag: str = "--pool") -> dict[str, int]:
     pools: dict[str, int] = {}
     for pair in pairs or []:
         name, sep, size = pair.partition("=")
         name = name.strip()
         if not sep or not name:
-            raise ValueError(f"bad --pool {pair!r}: expected NAME=SIZE")
+            raise ValueError(f"bad {flag} {pair!r}: expected NAME=SIZE")
         try:
             pools[name] = int(size)
         except ValueError:
-            raise ValueError(f"bad --pool {pair!r}: SIZE must be int") from None
+            raise ValueError(f"bad {flag} {pair!r}: SIZE must be int") from None
     return pools
 
 
@@ -678,6 +678,30 @@ async def _amain(args) -> int:
             )
             rollout_task = asyncio.create_task(rollout_ctl.run())
 
+    # -- autoscale actuation seam (ISSUE 20): once the initial population
+    # converges, apply --scale-pool sizes through the brain's fenced +
+    # journaled path — the chaos harness times a kill -9 against this to
+    # prove a successor adopts mid-scale-up instead of double-spawning --
+    scale_sizes = parse_pool_args(args.scale_pool, flag="--scale-pool")
+    scale_sizes = {
+        n: s for n, s in scale_sizes.items()
+        if controller is not None and n in controller.pools
+    }
+    scale_brain = None
+    if scale_sizes:
+        from spotter_tpu.serving.autoscale import AutoscalerBrain, ModelPool
+
+        scale_brain = AutoscalerBrain(
+            controller,
+            [
+                ModelPool(model=n, max_size=max(s, 1))
+                for n, s in scale_sizes.items()
+            ],
+            store=store,
+            fence=reconciler.fence if reconciler is not None else None,
+        )
+    scaled = False
+
     # -- run until told to stop --
     rollout_result = None
     while not stop_event.is_set():
@@ -707,11 +731,27 @@ async def _amain(args) -> int:
         if reconciler is None and lease is not None:
             # rollout-only controller still heartbeats its lease
             lease.try_acquire()
+        if scale_brain is not None and not scaled:
+            converged = all(
+                controller.pools[n].pool.has_available()
+                and len(controller.pools[n].members)
+                >= controller.pools[n].spec.target_size
+                for n in scale_sizes
+            )
+            if converged:
+                try:
+                    for n, s in scale_sizes.items():
+                        scale_brain.actuate(n, s, "drill: --scale-pool")
+                    scaled = True
+                except Exception:
+                    logger.exception("--scale-pool actuation failed")
+                    scaled = True  # fenced-out or broken: do not retry-spam
         extra = {
             "rollout": rollout_ctl.snapshot() if rollout_ctl else None,
             "rollout_result": rollout_result,
             "fleet": controller.snapshot() if controller else None,
             "seq": store.seq,
+            "scaled": scaled,
         }
         write_status("leading" if lease.leading else "deposed", extra)
         try:
@@ -758,6 +798,11 @@ def main(argv=None) -> int:
     parser.add_argument("--pool", action="append", default=[],
                         metavar="NAME=SIZE",
                         help="fleet-managed pool seed (repeatable)")
+    parser.add_argument("--scale-pool", action="append", default=[],
+                        metavar="NAME=SIZE",
+                        help="after initial convergence, scale this pool to "
+                        "SIZE through the fenced+journaled autoscaler path "
+                        "(repeatable; the crash-mid-scale drill seam)")
     parser.add_argument("--serve-pool", default="",
                         help="rollout-managed pool name (not fleet-spawned)")
     parser.add_argument("--serve-size", type=int, default=0)

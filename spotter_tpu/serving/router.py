@@ -139,6 +139,25 @@ def tenant_shed_response(exc: tenancy.TenantQuotaError) -> web.Response:
     )
 
 
+def model_routing_response(exc) -> web.Response:
+    """400 for a request the model router cannot place (ISSUE 20: unknown
+    model, or open-vocabulary `queries` against a closed-set fleet). Same
+    shed contract as the 429s — structured body with `status` and `error`,
+    X-Request-ID echoed by the edge trace — but no Retry-After: a routing
+    400 is a CLIENT defect, not load state, and retrying it unchanged can
+    never succeed. The body names the registry (`families`) so the caller
+    can self-correct from the response alone."""
+    return web.json_response(
+        {
+            "error": str(exc),
+            "status": exc.status,
+            "kind": exc.kind,
+            "families": exc.families,
+        },
+        status=exc.status,
+    )
+
+
 class _BadGateway(RuntimeError):
     """A sub-response the fan-in cannot merge (non-200 in a split request,
     malformed frame): surfaced to the client as 502."""

@@ -1699,3 +1699,681 @@ def run_tenant_matrix(
     for sc in scenarios if scenarios is not None else TENANT_MATRIX:
         reports.append(asyncio.run(run_tenant_scenario(sc)))
     return reports
+
+
+# ---------------------------------------------------------------------------
+# model-multiplexed autoscaling drills (ISSUE 20): per-model pools behind
+# the real fleet edge, sized by the AutoscalerBrain under scripted demand
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ScaleScenario:
+    """One deterministic autoscaling drill.
+
+    In-process rows (`crash=False`): per-model pools of `_ScaleMember`
+    stubs (real aiohttp servers whose /healthz stays 503 for
+    `cold_start_s` after a spawn — the compile-cache-restore window)
+    behind the REAL `FleetController` + `make_fleet_app` edge with an
+    `AutoscalerBrain` attached. `pools` maps model -> config
+    (initial/max/cold_start_s/scale_to_zero_s/open_vocab); `phases` is
+    the scripted workload: {"send": n, "model": ..., "tenant": ...,
+    "concurrency": k}, {"sleep": s}, or {"wait_zero": pool} (bounded
+    wait for the idle reclaim). `tenants` arms a frozen-clock
+    TenantPlane and `faults` carries the ISSUE 19 flood seams, so the
+    flood row proves the brain scales in-quota demand while quotas hold
+    the abuser flat.
+
+    The `crash=True` row is the subprocess sibling: a REAL controller
+    (`python -m spotter_tpu.serving.reconcile --scale-pool`) journals a
+    scale-up, spawns, and is SIGKILLed mid-scale-up; the successor must
+    adopt the live members and converge to the JOURNALED size with zero
+    double-spawns — run via `run_scale_crash_scenario(sc, workdir)`."""
+
+    name: str
+    pools: dict = field(default_factory=dict)
+    default_pool: str = "rtdetr"
+    phases: list = field(default_factory=list)
+    tenants: dict = field(default_factory=dict)
+    faults: dict = field(default_factory=dict)
+    brain: dict = field(default_factory=dict)  # AutoscalerBrain overrides
+    service_ms: float = 2.0
+    crash: bool = False
+    scale_size: int = 3  # crash row: journaled scale-up target
+    converge_timeout_s: float = 60.0
+    invariants: dict = field(default_factory=dict)
+
+
+SCALE_MATRIX = [
+    ScaleScenario(
+        # a burst of traffic for a model whose pool is COLD (size 0): the
+        # first routed request wakes the pool through the brain's fenced
+        # demand-restore path, the burst waits out the cold start, and
+        # every request completes — time_to_ready measured per restore.
+        name="burst-to-cold-model",
+        pools={
+            "rtdetr": {"initial": 1, "min": 1},
+            "yolos": {"initial": 0, "cold_start_s": 0.2},
+        },
+        phases=[
+            {"send": 4, "model": "rtdetr"},
+            {"send": 10, "model": "yolos", "concurrency": 5},
+        ],
+        invariants={
+            "client_failures": 0,
+            "wakes_ge": 1,
+            "ready_ge": {"yolos": 1},
+            "routed_correctly": True,
+            "time_to_ready_lt": 15.0,
+        },
+    ),
+    ScaleScenario(
+        # idle reclaim: a warm pool idle past scale_to_zero_s is drained
+        # to zero by the controller's idle timer (chips released); the
+        # next routed request restores it through the brain's wake path
+        # with the restore timed — and zero client-visible failures.
+        name="idle-reclaim",
+        pools={
+            "rtdetr": {"initial": 1, "min": 1},
+            "yolos": {
+                "initial": 1, "scale_to_zero_s": 0.8, "cold_start_s": 0.15,
+            },
+        },
+        phases=[
+            {"send": 4, "model": "yolos", "concurrency": 1},
+            {"wait_zero": "yolos"},
+            {"send": 3, "model": "yolos", "concurrency": 1},
+        ],
+        invariants={
+            "client_failures": 0,
+            "scale_to_zero": {"yolos": 1},
+            "restores": {"yolos": 1},
+            "routed_correctly": True,
+            "time_to_ready_lt": 15.0,
+        },
+    ),
+    ScaleScenario(
+        # flood vs in-quota demand, concurrently: an over-quota tenant
+        # floods yolos at 8x its (tiny) quota while an honest tenant runs
+        # sustained in-quota load on rtdetr. The quotas shed the flood
+        # BEFORE routing, so the brain sees only admitted demand: rtdetr
+        # scales UP for the honest tenant, the flooded pool's target
+        # stays flat (its admitted trickle is under every threshold),
+        # honest traffic never fails, and the brain records explicit
+        # flood holds while sheds are rising.
+        name="flood-vs-in-quota-demand",
+        pools={
+            "rtdetr": {"initial": 1, "min": 1, "max": 2},
+            "yolos": {"initial": 1, "min": 1, "max": 2},
+        },
+        tenants={"abuser": {"rps": 1}, "honest": {"rps": 500}},
+        faults={"tenant_flood": "abuser:8"},
+        brain={"inflight_high": 3.0},
+        service_ms=20.0,
+        phases=[
+            {
+                "parallel": [
+                    {"send": 12, "model": "yolos", "tenant": "abuser",
+                     "concurrency": 6},
+                    {"send": 40, "model": "rtdetr", "tenant": "honest",
+                     "concurrency": 4},
+                ]
+            },
+            # second flood wave after the honest load: sheds keep rising
+            # across policy ticks with zero in-quota yolos demand — the
+            # explicit-hold path
+            {"sleep": 0.06},
+            {"send": 6, "model": "yolos", "tenant": "abuser",
+             "concurrency": 6},
+            {"sleep": 0.06},
+        ],
+        invariants={
+            "honest_failures": 0,
+            "abuser_sheds_gt": 0,
+            "scale_ups_ge": 1,       # in-quota rtdetr demand DID scale
+            "targets_eq": {"yolos": 1},  # the flooded pool never moved
+            "flood_suppressions_ge": 1,
+        },
+    ),
+    ScaleScenario(
+        # kill -9 mid-scale-up: the leader journals desired size 3 via the
+        # fenced autoscaler path, spawns, and dies before the members are
+        # ready. The successor must adopt every live member from the
+        # manifest and converge to the JOURNALED size — zero double-spawns.
+        name="controller-crash-mid-scale",
+        crash=True,
+        scale_size=3,
+        invariants={
+            "adopted_all": True,
+            "no_double_spawn": True,
+            "journaled_size": 3,
+            "converged": True,
+        },
+    ),
+]
+
+SCALE_MATRIX_FAST = [sc for sc in SCALE_MATRIX if not sc.crash]
+
+
+class _ScaleMember:
+    """In-process managed member for the scale drills: a real aiohttp
+    server whose /healthz stays 503 for `cold_start_s` after each spawn
+    (the compile-cache-restore window), with the MemberHandle surface the
+    FleetController drives. `shutdown` only flips flags — it is called
+    from an executor thread by the controller's retire path."""
+
+    def __init__(self, name: str, pool: str, service_s: float,
+                 cold_start_s: float) -> None:
+        self.name = name
+        self.pool = pool
+        self.service_s = service_s
+        self.cold_start_s = cold_start_s
+        self.url = ""
+        self.server = None
+        self._serving = False
+        self._up_at = 0.0
+        self.spawns = 0
+
+    async def start(self) -> None:
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        async def detect(request):
+            await asyncio.sleep(self.service_s)
+            if not self._serving:
+                return web.json_response({"error": "down"}, status=503)
+            return web.json_response(
+                {"served_by": self.name, "pool": self.pool}
+            )
+
+        async def healthz(request):
+            import time as _time
+
+            if self._serving and _time.monotonic() >= self._up_at:
+                return web.json_response({"status": "ok"})
+            return web.json_response({"status": "starting"}, status=503)
+
+        app = web.Application()
+        app.router.add_post("/detect", detect)
+        app.router.add_get("/healthz", healthz)
+        self.server = TestServer(app)
+        await self.server.start_server()
+        self.url = f"http://{self.server.host}:{self.server.port}"
+
+    def spawn(self) -> "_ScaleMember":
+        import time as _time
+
+        self._serving = True
+        self._up_at = _time.monotonic() + self.cold_start_s
+        self.spawns += 1
+        return self
+
+    # -- MemberHandle protocol --
+
+    def alive(self) -> bool:
+        return True
+
+    def preempt(self) -> None:
+        self._serving = False
+
+    def clear_preemption(self) -> None:
+        pass
+
+    def shutdown(self, timeout_s: float = 10.0) -> str:
+        self._serving = False
+        return "stopped"
+
+    async def close(self) -> None:
+        if self.server is not None:
+            await self.server.close()
+
+
+async def run_scale_scenario(sc: ScaleScenario) -> dict:
+    """Execute one in-process autoscaling drill; returns the report dict
+    (see `evaluate_scale`). Crash rows go through
+    `run_scale_crash_scenario` instead."""
+    import random
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from spotter_tpu.obs.aggregate import FleetAggregator
+    from spotter_tpu.serving import tenancy
+    from spotter_tpu.serving.autoscale import AutoscalerBrain, ModelPool
+    from spotter_tpu.serving.fleet import (
+        FleetController,
+        PoolSpec,
+        make_fleet_app,
+    )
+
+    if sc.crash:
+        raise ValueError(
+            f"{sc.name}: crash rows need run_scale_crash_scenario(sc, workdir)"
+        )
+
+    # one pre-started stock of members per pool; the spawner pops and
+    # "boots" them (cold_start_s of 503 /healthz before ready)
+    stocks: dict[str, list[_ScaleMember]] = {}
+    all_members: list[_ScaleMember] = []
+    specs = []
+    model_pools = []
+    for pool_name, cfg in sc.pools.items():
+        max_size = int(cfg.get("max", 2))
+        stock = []
+        for i in range(max_size):
+            m = _ScaleMember(
+                f"{pool_name}-m{i}", pool_name,
+                service_s=sc.service_ms / 1000.0,
+                cold_start_s=float(cfg.get("cold_start_s", 0.0)),
+            )
+            await m.start()
+            stock.append(m)
+            all_members.append(m)
+        stocks[pool_name] = stock
+
+        def make_spawner(name=pool_name):
+            def spawner():
+                st = stocks[name]
+                for m in st:
+                    if not m._serving:
+                        return m.spawn()
+                raise RuntimeError(f"pool {name}: stock exhausted")
+            return spawner
+
+        specs.append(
+            PoolSpec(
+                pool_name,
+                spawner=make_spawner(),
+                target_size=int(cfg.get("initial", 0)),
+                scale_to_zero_s=float(cfg.get("scale_to_zero_s", 0.0)),
+            )
+        )
+        model_pools.append(
+            ModelPool(
+                model=pool_name,
+                open_vocab=bool(cfg.get("open_vocab", False)),
+                min_size=int(cfg.get("min", 0)),
+                max_size=max_size,
+                default=pool_name == sc.default_pool,
+            )
+        )
+
+    controller = FleetController(
+        specs,
+        tick_s=0.05,
+        restore_wait_s=10.0,
+        unavailable_wait_s=2.0,
+        respawn_base_s=0.05,
+        pool_kwargs=dict(
+            eject_threshold=1, backoff_base_s=0.05, backoff_max_s=0.2,
+            health_interval_s=0.05,
+        ),
+    )
+    plane = None
+    if sc.tenants:
+        # frozen clock: buckets never refill — admits == min(sent, burst)
+        plane = tenancy.TenantPlane(
+            config=sc.tenants,
+            clock=lambda: 0.0,
+            rng=random.Random(0),
+            trust_header=True,
+        )
+    brain = AutoscalerBrain(
+        controller,
+        model_pools,
+        tenancy_plane=plane,
+        tick_s=0.05,
+        down_steps=3,
+        **sc.brain,
+    )
+    aggregator = FleetAggregator(lambda: [], interval_s=0.0)  # determinism
+    app = make_fleet_app(
+        controller, aggregator=aggregator, tenancy_plane=plane,
+        autoscaler=brain,
+    )
+
+    statuses: dict[int, int] = {}
+    per_tenant: dict[str, dict[int, int]] = {}
+    client_failures = 0
+    misrouted = 0
+
+    with faults.inject(**sc.faults):
+        flood = faults.tenant_flood_spec()
+
+        async with TestClient(TestServer(app)) as client:
+            # initial population must be READY before the script starts —
+            # a half-booted warm pool would fail fast (it is not
+            # `restoring`, so SLO requests don't wait), which is a boot
+            # race, not the behavior under test
+            deadline = asyncio.get_running_loop().time() + 10.0
+            import time as _time
+
+            def _warm() -> bool:
+                return all(
+                    controller.pools[n].member_states(_time.monotonic()).get(
+                        "ready", 0
+                    ) >= int(cfg.get("initial", 0))
+                    for n, cfg in sc.pools.items()
+                )
+
+            while not _warm():
+                if asyncio.get_running_loop().time() > deadline:
+                    raise TimeoutError(f"{sc.name}: initial pools not ready")
+                await asyncio.sleep(0.02)
+
+            async def one(model: str, tenant, i: int) -> None:
+                nonlocal client_failures, misrouted
+                headers = {}
+                if tenant:
+                    headers[tenancy.TENANT_HEADER] = tenant
+                resp = await client.post(
+                    "/detect",
+                    json={
+                        "model": model,
+                        "image_urls": [URL_CYCLE[i % len(URL_CYCLE)]],
+                    },
+                    headers=headers,
+                )
+                body = await resp.json()
+                statuses[resp.status] = statuses.get(resp.status, 0) + 1
+                if tenant:
+                    stats = per_tenant.setdefault(tenant, {})
+                    stats[resp.status] = stats.get(resp.status, 0) + 1
+                if resp.status != 200:
+                    client_failures += 1
+                elif body.get("pool") != model:
+                    misrouted += 1
+
+            async def send_phase(ph: dict) -> None:
+                n = int(ph["send"])
+                tenant = ph.get("tenant")
+                if (
+                    flood is not None and tenant == flood[0]
+                ):  # the fault IS the client's behavior
+                    n = int(n * flood[1])
+                cursor = {"i": 0}
+
+                async def worker() -> None:
+                    while cursor["i"] < n:
+                        i = cursor["i"]
+                        cursor["i"] += 1
+                        await one(ph["model"], tenant, i)
+
+                await asyncio.gather(
+                    *(worker() for _ in range(int(ph.get("concurrency", 2))))
+                )
+
+            async def wait_zero(pool_name: str) -> None:
+                fp = controller.pools[pool_name]
+                deadline = asyncio.get_running_loop().time() + 10.0
+                while not fp.scaled_to_zero:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise TimeoutError(
+                            f"{sc.name}: {pool_name} never scaled to zero"
+                        )
+                    await asyncio.sleep(0.05)
+
+            for ph in sc.phases:
+                if "send" in ph:
+                    await send_phase(ph)
+                elif "parallel" in ph:
+                    await asyncio.gather(
+                        *(send_phase(p) for p in ph["parallel"])
+                    )
+                elif "sleep" in ph:
+                    await asyncio.sleep(float(ph["sleep"]))
+                elif "wait_zero" in ph:
+                    await wait_zero(ph["wait_zero"])
+                else:
+                    raise ValueError(f"unknown phase {ph!r} in {sc.name}")
+
+            # settle: requests can complete a beat before the controller
+            # tick observes availability (it re-checks the replica pool
+            # directly), so wait for restore bookkeeping to land before
+            # snapshotting
+            settle_deadline = asyncio.get_running_loop().time() + 2.0
+            while any(fp.restoring for fp in controller.pools.values()):
+                if asyncio.get_running_loop().time() > settle_deadline:
+                    break
+                await asyncio.sleep(0.05)
+
+            brain_snap = brain.snapshot()
+            fleet_snap = controller.snapshot()
+            plane_snap = plane.snapshot() if plane is not None else None
+
+    for m in all_members:
+        await m.close()
+
+    abuser = None
+    if sc.faults.get("tenant_flood"):
+        abuser = str(sc.faults["tenant_flood"]).partition(":")[0]
+    honest = [t for t in per_tenant if t != abuser]
+    arow = (
+        (plane_snap or {}).get("tenants", {}).get(abuser, {}) if abuser else {}
+    )
+    restores = {
+        name: p["restores_total"] for name, p in fleet_snap["pools"].items()
+    }
+    report = {
+        "name": sc.name,
+        "statuses": statuses,
+        "per_tenant": per_tenant,
+        "client_failures": client_failures,
+        "misrouted": misrouted,
+        "honest_failures": sum(
+            c
+            for t in honest
+            for s, c in per_tenant.get(t, {}).items()
+            if s != 200
+        ),
+        "abuser_sheds": int(
+            arow.get("sheds_rate_total", 0)
+            + arow.get("sheds_inflight_total", 0)
+        ),
+        "wakes": brain_snap["wakes_total"],
+        "scale_ups": brain_snap["scale_ups_total"],
+        "flood_suppressions": brain_snap["flood_suppressions_total"],
+        "restores": restores,
+        "scale_to_zero": {
+            name: p["scale_to_zero_total"]
+            for name, p in fleet_snap["pools"].items()
+        },
+        "targets": {
+            name: p["desired"] for name, p in brain_snap["pools"].items()
+        },
+        "ready": {
+            name: p["ready"] for name, p in brain_snap["pools"].items()
+        },
+        "time_to_ready_s": {
+            name: p["time_to_ready_s"]
+            for name, p in fleet_snap["pools"].items()
+        },
+        "autoscale": brain_snap,
+    }
+    report["checks"] = evaluate_scale(sc, report)
+    report["ok"] = all(report["checks"].values())
+    return report
+
+
+def run_scale_crash_scenario(sc: ScaleScenario, workdir: str) -> dict:
+    """The controller-crash-mid-scale drill: REAL controller processes
+    over REAL supervised stub members. ctrl-a seeds one member, then
+    journals `--scale-pool rtdetr=<scale_size>` through the fenced
+    autoscaler path and spawns; the harness SIGKILLs it the moment the
+    status file shows the scale applied (members spawned, not yet ready).
+    ctrl-b must adopt every live member and converge to the JOURNALED
+    size with zero double-spawns."""
+    import os as _os
+    import time as _time
+
+    from spotter_tpu.serving.statestore import EndpointsManifest
+
+    pool_name = "rtdetr"
+    sc_dir = _os.path.join(workdir, sc.name)
+    state_dir = _os.path.join(sc_dir, "state")
+    _os.makedirs(state_dir, exist_ok=True)
+    manifest_path = _os.path.join(sc_dir, "endpoints.json")
+    manifest = EndpointsManifest(manifest_path)
+
+    base_args = ["--pool", f"{pool_name}=1"]
+    controllers: list[ControllerProc] = []
+    report: dict = {"name": sc.name}
+    try:
+        a = ControllerProc(
+            sc_dir, state_dir, manifest_path, "ctrl-a",
+            base_args + ["--scale-pool", f"{pool_name}={sc.scale_size}"],
+        )
+        controllers.append(a)
+        # the scale actuation fires only after the initial population
+        # converges; `scaled` in the status marks journal + spawn done —
+        # the members themselves are still booting, which is the point
+        a.wait_status(
+            lambda st: st.get("scaled") is True, 60.0, "scale-up journaled"
+        )
+        a.sigkill()
+
+        # the spawned supervisors self-register and OUTLIVE the dead
+        # controller; give registration a beat so alive_at_takeover counts
+        # what ctrl-b can actually see in the manifest
+        deadline = _time.monotonic() + 15.0
+        while _time.monotonic() < deadline:
+            alive = sum(
+                1 for e in manifest.entries().values() if _supervisor_alive(e)
+            )
+            if alive >= sc.scale_size:
+                break
+            _time.sleep(0.1)
+        report["alive_at_takeover"] = sum(
+            1 for e in manifest.entries().values() if _supervisor_alive(e)
+        )
+
+        b = ControllerProc(sc_dir, state_dir, manifest_path, "ctrl-b",
+                           base_args)
+        controllers.append(b)
+
+        def _converged(st: dict) -> bool:
+            if st.get("phase") != "leading":
+                return False
+            rec = st["reconcile"]
+            if rec["drift"].get(pool_name) != 0:
+                return False
+            pools = (st.get("fleet") or {}).get("pools") or {}
+            psnap = pools.get(pool_name) or {}
+            return (
+                bool(rec["converged"])
+                and psnap.get("size") == sc.scale_size
+                and psnap.get("state", {}).get("ready") == sc.scale_size
+            )
+
+        t0 = _time.monotonic()
+        final = b.wait_status(
+            _converged, sc.converge_timeout_s, "successor convergence"
+        )
+        report["converge_s"] = _time.monotonic() - t0
+        report["converged"] = True
+        report["successor"] = final
+        report["live_members"] = sum(
+            1
+            for e in manifest.entries().values()
+            if e.get("pool") == pool_name and _supervisor_alive(e)
+        )
+    except TimeoutError as exc:
+        report["converged"] = False
+        report["error"] = str(exc)
+        report.setdefault("alive_at_takeover", None)
+        report.setdefault(
+            "successor", controllers[-1].status() if controllers else {}
+        )
+        report.setdefault("live_members", None)
+    finally:
+        for ctl in controllers:
+            ctl.shutdown()
+        _teardown_members(manifest_path)
+
+    report["checks"] = evaluate_scale(sc, report)
+    report["ok"] = all(report["checks"].values())
+    return report
+
+
+def evaluate_scale(sc: ScaleScenario, report: dict) -> dict:
+    """Invariant name -> bool, same contract as `evaluate`."""
+    succ = (report.get("successor") or {}).get("reconcile") or {}
+    checks: dict[str, bool] = {}
+    for key, want in sc.invariants.items():
+        if key == "client_failures":
+            checks[key] = report["client_failures"] == want
+        elif key == "honest_failures":
+            checks[key] = report["honest_failures"] == want
+        elif key == "abuser_sheds_gt":
+            checks[key] = report["abuser_sheds"] > want
+        elif key == "wakes_ge":
+            checks[key] = report["wakes"] >= want
+        elif key == "scale_ups_ge":
+            checks[key] = report["scale_ups"] >= want
+        elif key == "flood_suppressions_ge":
+            checks[key] = report["flood_suppressions"] >= want
+        elif key == "routed_correctly":
+            checks[key] = (report["misrouted"] == 0) == want
+        elif key == "ready_ge":
+            checks[key] = all(
+                report["ready"].get(p, 0) >= n for p, n in want.items()
+            )
+        elif key == "targets_eq":
+            checks[key] = all(
+                report["targets"].get(p) == n for p, n in want.items()
+            )
+        elif key == "restores":
+            checks[key] = all(
+                report["restores"].get(p) == n for p, n in want.items()
+            )
+        elif key == "scale_to_zero":
+            checks[key] = all(
+                report["scale_to_zero"].get(p) == n for p, n in want.items()
+            )
+        elif key == "time_to_ready_lt":
+            # at least one measured restore, and every one under the bound
+            timed = [
+                t for t in report["time_to_ready_s"].values() if t is not None
+            ]
+            checks[key] = bool(timed) and max(timed) < want
+        elif key == "adopted_all":
+            checks[key] = (
+                succ.get("adoptions_total") == report.get("alive_at_takeover")
+            ) == want
+        elif key == "no_double_spawn":
+            # every live member is either adopted or a fresh spawn filling
+            # the journaled size — never one more than the journal asks
+            alive = report.get("alive_at_takeover")
+            spawned = succ.get("spawns_total")
+            checks[key] = (
+                alive is not None
+                and spawned == sc.scale_size - alive
+                and report.get("live_members") == sc.scale_size
+            ) == want
+        elif key == "journaled_size":
+            pools = (
+                (report.get("successor") or {}).get("fleet") or {}
+            ).get("pools") or {}
+            checks[key] = (pools.get("rtdetr") or {}).get("target_size") == want
+        elif key == "converged":
+            checks[key] = report.get("converged") == want
+        else:
+            raise ValueError(f"unknown invariant {key!r} in {sc.name}")
+    return checks
+
+
+def run_scale_matrix(
+    scenarios: list[ScaleScenario] | None = None,
+    workdir: str | None = None,
+) -> list[dict]:
+    """Run every autoscaling drill (fresh event loop per in-process row);
+    returns the reports — same contract as `run_matrix`. Crash rows need
+    `workdir` for their controller subprocesses."""
+    reports = []
+    for sc in scenarios if scenarios is not None else SCALE_MATRIX:
+        if sc.crash:
+            if workdir is None:
+                raise ValueError(f"{sc.name} needs workdir for subprocesses")
+            reports.append(run_scale_crash_scenario(sc, workdir))
+        else:
+            reports.append(asyncio.run(run_scale_scenario(sc)))
+    return reports

@@ -536,3 +536,68 @@ def test_fleet_top_render():
     assert "down" in r2
     # an edge without the aggregator armed is reported, not rendered empty
     assert "aggregator" in render({"pool_requests_total": 0})
+
+
+def test_fleet_top_render_autoscale_pools():
+    from tools.fleet_top import _autoscale_lines, render
+
+    snapshot = {
+        "fleet": {"replicas": {"seen": 0, "up": 0, "stale": 0,
+                               "generation_resets_total": 0},
+                  "per_replica": []},
+        "autoscale": {
+            "decisions_total": 7,
+            "scale_ups_total": 2,
+            "scale_downs_total": 1,
+            "wakes_total": 1,
+            "flood_suppressions_total": 3,
+            "routing_rejections_total": 4,
+            "default_pool": "rtdetr",
+            "pools": {
+                "rtdetr": {
+                    "model": "rtdetr", "open_vocab": False,
+                    "tp": 1, "dp": 2, "desired": 2, "ready": 2,
+                    "scaled_to_zero": False, "restoring": False,
+                    "time_to_ready_s": 0.42, "admits_total": 19,
+                    "inflight": 1,
+                    "last_decision": {"current": 1, "desired": 2,
+                                      "reason": "up: queue 5.0",
+                                      "age_s": 12.3},
+                },
+                "owlvit": {
+                    "model": "owlvit", "open_vocab": True,
+                    "tp": 2, "dp": 1, "desired": 0, "ready": 0,
+                    "scaled_to_zero": True, "restoring": False,
+                    "time_to_ready_s": None, "admits_total": 0,
+                    "inflight": 0, "last_decision": None,
+                },
+                "yolos": {
+                    "model": "yolos", "open_vocab": False,
+                    "tp": 1, "dp": 1, "desired": 1, "ready": 0,
+                    "scaled_to_zero": False, "restoring": True,
+                    "time_to_ready_s": None, "admits_total": 3,
+                    "inflight": 0, "last_decision": None,
+                },
+            },
+        },
+    }
+    out = render(snapshot)
+    lines = out.splitlines()
+    totals = next(ln for ln in lines if ln.startswith("autoscale:"))
+    assert "7 decisions (2 up, 1 down, 1 wakes)" in totals
+    assert "flood holds 3" in totals and "routing 400s 4" in totals
+    assert "default rtdetr" in totals
+    header = next(ln for ln in lines if "LAST DECISION" in ln)
+    assert "POOL" in header and "DES" in header and "TTR_S" in header
+    rt = next(ln for ln in lines if ln.startswith("rtdetr"))
+    assert "tp1xdp2" in rt and "0.42" in rt
+    assert "1->2 up: queue 5.0 (12s ago)" in rt
+    owl = next(ln for ln in lines if ln.startswith("owlvit"))
+    assert "owlvit*" in owl and "zero" in owl and "tp2xdp1" in owl
+    yo = next(ln for ln in lines if ln.startswith("yolos"))
+    assert "restoring" in yo
+    # pool rows sort by name regardless of dict order
+    assert lines.index(owl) < lines.index(rt) < lines.index(yo)
+    # absent-plane discipline: no autoscale block, no autoscale lines
+    assert _autoscale_lines({"fleet": {}}) == []
+    assert "autoscale:" not in render({"fleet": snapshot["fleet"]})

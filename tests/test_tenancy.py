@@ -689,16 +689,19 @@ def test_retry_after_header_never_zero():
 
 
 def test_shed_contract_table_across_surfaces(monkeypatch):
-    """Table-driven 429/503 contract: EVERY shed surface echoes the
-    request id, carries Retry-After, and returns a JSON error body with
-    the status repeated — whichever layer shed (tenant quota 429, the
-    brownout bulk rung 503, batcher queue-full 429)."""
+    """Table-driven shed/reject contract: EVERY rejecting surface echoes
+    the request id and returns a JSON error body with the status
+    repeated — whichever layer rejected (tenant quota 429, the brownout
+    bulk rung 503, batcher queue-full 429, model-routing 400). Load
+    sheds carry Retry-After; routing 400s must NOT (a client defect —
+    retrying it unchanged can never succeed) and name the registry
+    instead so the caller can self-correct (ISSUE 20 parity)."""
     monkeypatch.delenv(TENANT_KEYS_ENV, raising=False)
     monkeypatch.delenv(TENANT_RPS_DEFAULT_ENV, raising=False)
 
     async def quota_app():
         det = _stub_detector()
-        return det, make_app(detector=det), {TENANT_HEADER: "t"}, 429
+        return det.aclose, make_app(detector=det), {TENANT_HEADER: "t"}, 429
 
     async def brownout_app():
         from spotter_tpu.serving.overload import BrownoutController
@@ -718,7 +721,10 @@ def test_shed_contract_table_across_surfaces(monkeypatch):
         for _ in range(4):  # rung 4: bulk-only 503
             clock.advance(1.1)
             bc.evaluate()
-        return det, make_app(detector=det), {"X-Request-Class": "bulk"}, 503
+        return (
+            det.aclose, make_app(detector=det),
+            {"X-Request-Class": "bulk"}, 503,
+        )
 
     async def queue_full_app():
         eng = StubEngine(service_ms=200.0)
@@ -727,29 +733,69 @@ def test_shed_contract_table_across_surfaces(monkeypatch):
             MicroBatcher(eng, max_delay_ms=200.0, max_queue=1),
             StubHttpClient(),
         )
-        return det, make_app(detector=det), {}, 429
+        return det.aclose, make_app(detector=det), {}, 429
+
+    async def routing_app():
+        # closed-set single-family fleet edge with the autoscaler armed:
+        # an unroutable request 400s BEFORE any pool access, so the pool
+        # stays empty (target 0) and no member is ever needed
+        from spotter_tpu.obs.aggregate import FleetAggregator
+        from spotter_tpu.serving.autoscale import AutoscalerBrain, ModelPool
+        from spotter_tpu.serving.fleet import (
+            FleetController,
+            PoolSpec,
+            make_fleet_app,
+        )
+
+        controller = FleetController(
+            [PoolSpec("rtdetr", spawner=lambda: None, target_size=0)],
+            tick_s=0.05,
+        )
+        brain = AutoscalerBrain(
+            controller,
+            [ModelPool(model="rtdetr", min_size=0, max_size=1,
+                       default=True)],
+            tick_s=0.25,
+        )
+        app = make_fleet_app(
+            controller,
+            aggregator=FleetAggregator(lambda: [], interval_s=0.0),
+            autoscaler=brain,
+        )
+
+        async def noop():
+            return None
+
+        return noop, app, {}, 400
 
     async def run():
+        # (name, build, tenant_cfg, payload_extra, retry_after)
         rows = [
             ("tenant-quota", quota_app,
-             '{"default": {"rps": 0.001, "burst": 1}}'),
-            ("brownout-bulk", brownout_app, ""),
-            ("queue-full", queue_full_app, ""),
+             '{"default": {"rps": 0.001, "burst": 1}}', {}, True),
+            ("brownout-bulk", brownout_app, "", {}, True),
+            ("queue-full", queue_full_app, "", {}, True),
+            ("unknown-model", routing_app, "",
+             {"model": "segment-anything"}, False),
+            ("closed-set-queries", routing_app, "",
+             {"queries": ["a solar panel"]}, False),
         ]
-        for name, build, tenant_cfg in rows:
+        for name, build, tenant_cfg, payload_extra, retry_after in rows:
             if tenant_cfg:
                 monkeypatch.setenv(TENANT_CONFIG_ENV, tenant_cfg)
             else:
                 monkeypatch.delenv(TENANT_CONFIG_ENV, raising=False)
-            det, app, headers, want_status = await build()
+            aclose, app, headers, want_status = await build()
             async with TestClient(TestServer(app)) as client:
                 # concurrent burst: one request fills the quota/queue slot,
-                # the rest hit the shed surface under test
+                # the rest hit the shed surface under test (routing rows
+                # reject all 8 — the defect is in the request itself)
                 resps = await asyncio.gather(*(
                     client.post(
                         "/detect",
                         json={
-                            "image_urls": [f"http://example.com/{i}.jpg"]
+                            "image_urls": [f"http://example.com/{i}.jpg"],
+                            **payload_extra,
                         },
                         headers={
                             **headers, "X-Request-ID": f"rid-{name}-{i}"
@@ -769,14 +815,26 @@ def test_shed_contract_table_across_surfaces(monkeypatch):
                     assert (
                         shed.headers["X-Request-ID"] == f"rid-{name}-{i}"
                     ), name
-                    assert "Retry-After" in shed.headers, name
+                    assert ("Retry-After" in shed.headers) is retry_after, (
+                        f"{name}: Retry-After "
+                        f"{'missing' if retry_after else 'present'}"
+                    )
                     body = await shed.json()
                     assert body["status"] == want_status, name
+                    if want_status == 400:
+                        assert body["kind"] in (
+                            "unknown_model", "closed_set_queries"
+                        ), name
+                        assert "rtdetr" in body["families"], name
                 for _, r in enumerate(resps):
                     await r.read()
                 metrics = await (await client.get("/metrics")).json()
-                assert metrics["shed_total"] >= 1, name
-            await det.aclose()
+                if want_status == 400:
+                    block = metrics["autoscale"]
+                    assert block["routing_rejections_total"] >= 8, name
+                else:
+                    assert metrics["shed_total"] >= 1, name
+            await aclose()
 
     asyncio.run(run())
 

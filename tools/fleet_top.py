@@ -22,7 +22,11 @@ desired-vs-observed drift per pool — so an operator sees "spot 2/3
 ready" next to the replica rows it explains. A tenancy-armed edge
 (ISSUE 19) carries a bounded `tenants` block, which renders as per-tenant
 rows (inflight, admits, sheds by kind, SLO burn) under the replica table
-— who is being shed, and who is eating the capacity, in one screen.
+— who is being shed, and who is eating the capacity, in one screen. An
+autoscaler-wired edge (ISSUE 20) carries an `autoscale` block, rendered
+as per-model-pool rows — desired vs ready, pool shape (tp×dp),
+scaled-to-zero/restoring state, the last restore's time_to_ready_s, and
+the last sizing decision with its reason.
 """
 
 import argparse
@@ -135,6 +139,78 @@ def _tenant_lines(snapshot: dict) -> list[str]:
     return lines
 
 
+POOL_COLUMNS = (
+    # (header, width) — cells are synthesized per pool in _autoscale_lines
+    ("POOL", 16),
+    ("SHAPE", 7),
+    ("DES", 4),
+    ("RDY", 4),
+    ("STATE", 9),
+    ("ADMITS", 8),
+    ("INFLT", 6),
+    ("TTR_S", 7),
+    ("LAST DECISION", 40),
+)
+
+
+def _autoscale_lines(snapshot: dict) -> list[str]:
+    """Per-model-pool rows (ISSUE 20) from the `autoscale` block a
+    brain-wired edge embeds in /metrics: desired vs ready, pool shape,
+    scaled-to-zero/restoring state with the last restore's time-to-ready,
+    and the last sizing decision with its reason. Absent-plane discipline:
+    no block, no lines."""
+    auto = snapshot.get("autoscale")
+    if not isinstance(auto, dict) or not isinstance(auto.get("pools"), dict):
+        return []
+    totals = (
+        f"autoscale: {int(auto.get('decisions_total', 0) or 0)} decisions "
+        f"({int(auto.get('scale_ups_total', 0) or 0)} up, "
+        f"{int(auto.get('scale_downs_total', 0) or 0)} down, "
+        f"{int(auto.get('wakes_total', 0) or 0)} wakes) | "
+        f"flood holds {int(auto.get('flood_suppressions_total', 0) or 0)} | "
+        f"routing 400s {int(auto.get('routing_rejections_total', 0) or 0)} | "
+        f"default {auto.get('default_pool') or '-'}"
+    )
+    lines = ["", totals, "  ".join(h.ljust(w) for h, w in POOL_COLUMNS)]
+    for name, row in sorted(auto["pools"].items()):
+        row = row if isinstance(row, dict) else {}
+        if row.get("scaled_to_zero"):
+            state = "zero"
+        elif row.get("restoring"):
+            state = "restoring"
+        else:
+            state = "ready"
+        ttr = row.get("time_to_ready_s")
+        dec = row.get("last_decision") or {}
+        if dec:
+            last = (
+                f"{int(dec.get('current', 0) or 0)}->"
+                f"{int(dec.get('desired', 0) or 0)} "
+                f"{dec.get('reason') or ''} "
+                f"({float(dec.get('age_s', 0) or 0):.0f}s ago)"
+            )
+        else:
+            last = "-"
+        vocab = "*" if row.get("open_vocab") else ""
+        cells = (
+            f"{name}{vocab}",
+            f"tp{int(row.get('tp', 1) or 1)}xdp{int(row.get('dp', 1) or 1)}",
+            str(int(row.get("desired", 0) or 0)),
+            str(int(row.get("ready", 0) or 0)),
+            state,
+            str(int(row.get("admits_total", 0) or 0)),
+            str(int(row.get("inflight", 0) or 0)),
+            "-" if ttr is None else f"{float(ttr):.2f}",
+            last,
+        )
+        lines.append(
+            "  ".join(
+                c[:w].ljust(w) for c, (_h, w) in zip(cells, POOL_COLUMNS)
+            )
+        )
+    return lines
+
+
 def _state(row: dict) -> str:
     if not row.get("up"):
         return "down"
@@ -186,6 +262,7 @@ def render(snapshot: dict) -> str:
         lines.append("  ".join(cells))
     if not fleet.get("per_replica"):
         lines.append("(no replicas scraped yet)")
+    lines.extend(_autoscale_lines(snapshot))
     lines.extend(_tenant_lines(snapshot))
     return "\n".join(lines)
 
